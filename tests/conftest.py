@@ -1,0 +1,18 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh so
+multi-chip sharding is exercised without TPU hardware (the reference has no
+distributed tests at all — SURVEY.md §4).
+
+Note: this environment's axon sitecustomize pre-imports jax and pins
+JAX_PLATFORMS=axon, so plain env vars are not enough — we must update the
+jax config before the backend initializes.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,76 @@
+"""Model construction from a ModelConfig.
+
+`model_path` dispatch:
+- "random:<preset>" — from-scratch init with a named preset
+  (trlx_tpu/models/transformer.py PRESETS); offline-friendly.
+- anything else — treated as an HF checkpoint directory/name and loaded
+  via trlx_tpu/models/hf_interop.py (torch-cpu weight conversion).
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.heads import ILQLHeads, MLPHead, sync_target_q_heads  # noqa: F401
+from trlx_tpu.models.policy import (  # noqa: F401
+    CausalLMWithILQLHeads,
+    CausalLMWithValueHead,
+    forward_policy_and_ref,
+    ref_param_subtree,
+    resolve_split,
+    target_q_mask,
+    trainable_mask,
+)
+from trlx_tpu.models.transformer import (  # noqa: F401
+    PRESETS,
+    TransformerConfig,
+    TransformerLM,
+    config_from_preset,
+    init_kv_cache,
+    position_ids,
+)
+
+
+def resolve_transformer_config(model_config, vocab_size: int) -> TransformerConfig:
+    """Build a TransformerConfig from a trlx_tpu ModelConfig."""
+    path = model_config.model_path
+    extra = dict(model_config.model_extra_configs or {})
+    dtype_overrides = {}
+    if "dtype" in extra:
+        dtype_overrides["dtype"] = jnp.dtype(extra.pop("dtype"))
+    if path.startswith("random:"):
+        preset = path[len("random:"):]
+        return config_from_preset(preset, vocab_size=vocab_size, **extra, **dtype_overrides)
+    from trlx_tpu.models import hf_interop
+
+    return hf_interop.config_from_hf(path, **extra, **dtype_overrides)
+
+
+def build_model(
+    model_config,
+    vocab_size: int,
+    rng: Optional[jax.Array] = None,
+    with_ilql_heads: bool = False,
+    two_qs: bool = True,
+    seq_len: int = 32,
+) -> Tuple[Any, TransformerConfig, Dict]:
+    """Returns (flax module, transformer config, initialized params)."""
+    cfg = resolve_transformer_config(model_config, vocab_size)
+    if with_ilql_heads:
+        model = CausalLMWithILQLHeads(cfg, two_qs=two_qs)
+    else:
+        model = CausalLMWithValueHead(cfg)
+
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    tokens = jnp.zeros((1, min(seq_len, cfg.max_seq_len)), dtype=jnp.int32)
+    mask = jnp.ones_like(tokens)
+    params = model.init(rng, tokens, mask)["params"]
+
+    if not model_config.model_path.startswith("random:"):
+        from trlx_tpu.models import hf_interop
+
+        params = hf_interop.load_params_from_hf(
+            model_config.model_path, cfg, params
+        )
+    return model, cfg, params

@@ -1,0 +1,94 @@
+"""Value / Q heads.
+
+Parity: the reference's `make_head` 2-layer MLP (trlx/utils/modeling.py:13-19)
+used by the PPO value head (modeling_ppo.py:266-382) and the ILQL heads
+(modeling_ilql.py:169-323). Target-Q Polyak sync is a pure function over
+param pytrees instead of in-place module copies.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLPHead(nn.Module):
+    """Linear(d -> 2d) -> ReLU -> Linear(2d -> n_out), matching the
+    reference's make_head."""
+
+    n_out: int
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = nn.Dense(d * 2, dtype=self.dtype, param_dtype=self.param_dtype, name="dense_in")(x)
+        x = nn.relu(x)
+        # Head outputs are computed in f32: value/Q regression is sensitive.
+        x = nn.Dense(self.n_out, dtype=jnp.float32, param_dtype=self.param_dtype, name="dense_out")(x)
+        return x
+
+
+class ILQLHeads(nn.Module):
+    """V head + 1-2 Q heads + target Q heads (reference modeling_ilql.py:169-323).
+
+    Target heads are declared as ordinary params here; the trainer masks
+    them out of the optimizer and syncs them with `sync_target_q_heads`
+    (Polyak) every `steps_for_target_q_sync` steps — the functional
+    counterpart of the reference's in-place `_sync_target_q_heads`."""
+
+    vocab_size: int
+    two_qs: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+    param_dtype: jnp.dtype = jnp.float32
+
+    def setup(self):
+        n_qs = 2 if self.two_qs else 1
+        self.q_heads = [
+            MLPHead(self.vocab_size, self.dtype, self.param_dtype, name=f"q_head_{i}")
+            for i in range(n_qs)
+        ]
+        self.target_q_heads = [
+            MLPHead(self.vocab_size, self.dtype, self.param_dtype, name=f"target_q_head_{i}")
+            for i in range(n_qs)
+        ]
+        self.v_head = MLPHead(1, self.dtype, self.param_dtype, name="v_head")
+
+    def __call__(
+        self,
+        hs: jnp.ndarray,  # [b, t, d]
+        states_ixs: Optional[jnp.ndarray] = None,  # [b, n_states]
+        actions_ixs: Optional[jnp.ndarray] = None,  # [b, n_actions]
+    ):
+        """Returns (qs, target_qs, vs). If index arrays are given, Q heads
+        run only on action positions and the V head on state positions
+        (reference modeling_ilql.py:244-264)."""
+        states_hs = (
+            jnp.take_along_axis(hs, states_ixs[..., None], axis=1) if states_ixs is not None else hs
+        )
+        actions_hs = (
+            jnp.take_along_axis(hs, actions_ixs[..., None], axis=1) if actions_ixs is not None else hs
+        )
+        qs = tuple(qh(actions_hs) for qh in self.q_heads)
+        target_qs = tuple(
+            jax.lax.stop_gradient(tqh(actions_hs)) for tqh in self.target_q_heads
+        )
+        vs = self.v_head(states_hs)
+        return qs, target_qs, vs
+
+
+def sync_target_q_heads(heads_params: dict, alpha: float) -> dict:
+    """Polyak update target <- alpha * q + (1 - alpha) * target over an
+    ILQLHeads param subtree (reference modeling_ilql.py:216-227)."""
+    new = dict(heads_params)
+    for name, sub in heads_params.items():
+        if name.startswith("q_head_"):
+            target_name = "target_" + name
+            new[target_name] = jax.tree_util.tree_map(
+                lambda q, t: alpha * q + (1.0 - alpha) * t,
+                sub,
+                heads_params[target_name],
+            )
+    return new

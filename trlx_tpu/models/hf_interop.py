@@ -1,0 +1,230 @@
+"""HF checkpoint interop: build a TransformerConfig from an HF config.json
+and convert torch state dicts into our Flax param pytrees (and back, for
+`save_pretrained` export).
+
+Parity: the reference's PreTrainedModelWrapper.from_pretrained /
+save_pretrained (trlx/models/modeling_base.py:44-374). Conversion runs on
+torch-cpu; this environment has no network egress, so only local
+directories / cached checkpoints work.
+
+Supported HF architectures: GPT2LMHeadModel, LlamaForCausalLM.
+"""
+
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+from trlx_tpu.models.transformer import TransformerConfig
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def _read_hf_config(path: str) -> Dict:
+    cfg_path = os.path.join(path, "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            return json.load(f)
+    # Fall back to transformers' resolution (hub cache) if available.
+    from transformers import AutoConfig
+
+    return AutoConfig.from_pretrained(path).to_dict()
+
+
+def config_from_hf(path: str, **overrides) -> TransformerConfig:
+    hf = _read_hf_config(path)
+    arch = (hf.get("architectures") or [hf.get("model_type", "")])[0]
+    if "gpt2" in arch.lower() or hf.get("model_type") == "gpt2":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["n_embd"],
+            n_layers=hf["n_layer"],
+            n_heads=hf["n_head"],
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=hf["n_positions"],
+            pos_embed="learned",
+            norm="layernorm",
+            activation="gelu",
+            glu=False,
+            tie_embeddings=True,
+            use_bias=True,
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        )
+    elif "llama" in arch.lower() or hf.get("model_type") == "llama":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"],
+            d_model=hf["hidden_size"],
+            n_layers=hf["num_hidden_layers"],
+            n_heads=hf["num_attention_heads"],
+            n_kv_heads=hf.get("num_key_value_heads"),
+            d_ff=hf["intermediate_size"],
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            pos_embed="rope",
+            norm="rmsnorm",
+            activation="silu",
+            glu=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            use_bias=False,
+            rope_theta=hf.get("rope_theta", 10000.0),
+            layer_norm_epsilon=hf.get("rms_norm_eps", 1e-6),
+        )
+    else:
+        raise ValueError(f"Unsupported HF architecture for conversion: {arch}")
+    kwargs.update(overrides)
+    return TransformerConfig(**kwargs)
+
+
+def _load_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Load an HF torch checkpoint into numpy (handles sharded bins and
+    safetensors)."""
+    import torch
+
+    tensors: Dict[str, np.ndarray] = {}
+    st_index = os.path.join(path, "model.safetensors.index.json")
+    bin_index = os.path.join(path, "pytorch_model.bin.index.json")
+    files = []
+    if os.path.exists(os.path.join(path, "model.safetensors")):
+        files = [os.path.join(path, "model.safetensors")]
+    elif os.path.exists(st_index):
+        with open(st_index) as f:
+            files = sorted({os.path.join(path, v) for v in json.load(f)["weight_map"].values()})
+    elif os.path.exists(os.path.join(path, "pytorch_model.bin")):
+        files = [os.path.join(path, "pytorch_model.bin")]
+    elif os.path.exists(bin_index):
+        with open(bin_index) as f:
+            files = sorted({os.path.join(path, v) for v in json.load(f)["weight_map"].values()})
+    else:
+        raise FileNotFoundError(f"No model weights found under {path}")
+
+    for f in files:
+        if f.endswith(".safetensors"):
+            from safetensors.torch import load_file
+
+            sd = load_file(f)
+        else:
+            sd = torch.load(f, map_location="cpu", weights_only=True)
+        for k, v in sd.items():
+            tensors[k] = v.float().numpy()
+    return tensors
+
+
+def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict) -> Dict:
+    """Convert an HF state dict into our param pytree, using the template's
+    structure/dtypes. Keys follow the GPT2/Llama HF layouts."""
+    sd = _load_state_dict(path)
+    is_gpt2 = any(k.startswith(("wte.", "transformer.wte.", "h.", "transformer.h.")) for k in sd)
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    lm: Dict = {}
+
+    def dt(template_leaf, arr):
+        return np.asarray(arr, dtype=np.dtype(template_leaf.dtype))
+
+    tpl_lm = params_template["lm"]
+    if is_gpt2:
+        lm["embed_tokens"] = {"embedding": sd[f"{prefix}wte.weight"]}
+        lm["embed_pos"] = {"embedding": sd[f"{prefix}wpe.weight"]}
+        for i in range(cfg.n_layers):
+            p = f"{prefix}h.{i}."
+            # GPT-2 fused qkv: c_attn.weight [d, 3d] (Conv1D layout: in x out)
+            qkv_w = sd[p + "attn.c_attn.weight"]
+            qkv_b = sd[p + "attn.c_attn.bias"]
+            qw, kw, vw = np.split(qkv_w, 3, axis=1)
+            qb, kb, vb = np.split(qkv_b, 3, axis=0)
+            lm[f"block_{i}"] = {
+                "ln_attn": {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+                "ln_mlp": {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+                "attn": {
+                    "q_proj": {"kernel": qw, "bias": qb},
+                    "k_proj": {"kernel": kw, "bias": kb},
+                    "v_proj": {"kernel": vw, "bias": vb},
+                    "o_proj": {"kernel": sd[p + "attn.c_proj.weight"], "bias": sd[p + "attn.c_proj.bias"]},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": sd[p + "mlp.c_fc.weight"], "bias": sd[p + "mlp.c_fc.bias"]},
+                    "down_proj": {"kernel": sd[p + "mlp.c_proj.weight"], "bias": sd[p + "mlp.c_proj.bias"]},
+                },
+            }
+        lm["ln_f"] = {"scale": sd[f"{prefix}ln_f.weight"], "bias": sd[f"{prefix}ln_f.bias"]}
+    else:  # llama
+        pre = "model." if any(k.startswith("model.") for k in sd) else ""
+        lm["embed_tokens"] = {"embedding": sd[f"{pre}embed_tokens.weight"]}
+        for i in range(cfg.n_layers):
+            p = f"{pre}layers.{i}."
+            lm[f"block_{i}"] = {
+                "ln_attn": {"scale": sd[p + "input_layernorm.weight"]},
+                "ln_mlp": {"scale": sd[p + "post_attention_layernorm.weight"]},
+                "attn": {
+                    # HF stores [out, in]; our Dense kernels are [in, out]
+                    "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T},
+                    "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T},
+                    "v_proj": {"kernel": sd[p + "self_attn.v_proj.weight"].T},
+                    "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
+                },
+                "mlp": {
+                    "gate_proj": {"kernel": sd[p + "mlp.gate_proj.weight"].T},
+                    "up_proj": {"kernel": sd[p + "mlp.up_proj.weight"].T},
+                    "down_proj": {"kernel": sd[p + "mlp.down_proj.weight"].T},
+                },
+            }
+        lm["ln_f"] = {"scale": sd[f"{pre}norm.weight"]}
+        if not cfg.tie_embeddings:
+            lm["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+
+    import jax
+
+    new_params = dict(params_template)
+    new_params["lm"] = jax.tree_util.tree_map(dt, tpl_lm, lm)
+    logger.info(f"Loaded HF weights from {path}")
+    return new_params
+
+
+def params_to_hf_state_dict(params: Dict, cfg: TransformerConfig) -> Dict:
+    """Export our LM params back to an HF-layout state dict (GPT-2/Llama),
+    for `save_pretrained` interop."""
+    lm = params["lm"]
+    sd: Dict[str, np.ndarray] = {}
+    gpt2 = cfg.pos_embed == "learned"
+    if gpt2:
+        sd["transformer.wte.weight"] = np.asarray(lm["embed_tokens"]["embedding"], np.float32)
+        sd["transformer.wpe.weight"] = np.asarray(lm["embed_pos"]["embedding"], np.float32)
+        for i in range(cfg.n_layers):
+            b = lm[f"block_{i}"]
+            p = f"transformer.h.{i}."
+            sd[p + "ln_1.weight"] = np.asarray(b["ln_attn"]["scale"], np.float32)
+            sd[p + "ln_1.bias"] = np.asarray(b["ln_attn"]["bias"], np.float32)
+            sd[p + "ln_2.weight"] = np.asarray(b["ln_mlp"]["scale"], np.float32)
+            sd[p + "ln_2.bias"] = np.asarray(b["ln_mlp"]["bias"], np.float32)
+            sd[p + "attn.c_attn.weight"] = np.concatenate(
+                [np.asarray(b["attn"][n]["kernel"], np.float32) for n in ("q_proj", "k_proj", "v_proj")], axis=1
+            )
+            sd[p + "attn.c_attn.bias"] = np.concatenate(
+                [np.asarray(b["attn"][n]["bias"], np.float32) for n in ("q_proj", "k_proj", "v_proj")], axis=0
+            )
+            sd[p + "attn.c_proj.weight"] = np.asarray(b["attn"]["o_proj"]["kernel"], np.float32)
+            sd[p + "attn.c_proj.bias"] = np.asarray(b["attn"]["o_proj"]["bias"], np.float32)
+            sd[p + "mlp.c_fc.weight"] = np.asarray(b["mlp"]["up_proj"]["kernel"], np.float32)
+            sd[p + "mlp.c_fc.bias"] = np.asarray(b["mlp"]["up_proj"]["bias"], np.float32)
+            sd[p + "mlp.c_proj.weight"] = np.asarray(b["mlp"]["down_proj"]["kernel"], np.float32)
+            sd[p + "mlp.c_proj.bias"] = np.asarray(b["mlp"]["down_proj"]["bias"], np.float32)
+        sd["transformer.ln_f.weight"] = np.asarray(lm["ln_f"]["scale"], np.float32)
+        sd["transformer.ln_f.bias"] = np.asarray(lm["ln_f"]["bias"], np.float32)
+        sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    else:
+        sd["model.embed_tokens.weight"] = np.asarray(lm["embed_tokens"]["embedding"], np.float32)
+        for i in range(cfg.n_layers):
+            b = lm[f"block_{i}"]
+            p = f"model.layers.{i}."
+            sd[p + "input_layernorm.weight"] = np.asarray(b["ln_attn"]["scale"], np.float32)
+            sd[p + "post_attention_layernorm.weight"] = np.asarray(b["ln_mlp"]["scale"], np.float32)
+            for n in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                sd[p + f"self_attn.{n}.weight"] = np.asarray(b["attn"][n]["kernel"], np.float32).T
+            for n in ("gate_proj", "up_proj", "down_proj"):
+                sd[p + f"mlp.{n}.weight"] = np.asarray(b["mlp"][n]["kernel"], np.float32).T
+        sd["model.norm.weight"] = np.asarray(lm["ln_f"]["scale"], np.float32)
+        if "lm_head" in lm:
+            sd["lm_head.weight"] = np.asarray(lm["lm_head"]["kernel"], np.float32).T
+        else:
+            sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd

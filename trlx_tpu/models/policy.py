@@ -1,0 +1,195 @@
+"""Policy model wrappers: LM + value head (PPO) and LM + ILQL heads, plus
+the param-pytree utilities that realize the reference's freezing/hydra
+machinery functionally.
+
+Parity map (reference -> here):
+- AutoModelForCausalLMWithValueHead (modeling_ppo.py:266-382)
+    -> CausalLMWithValueHead
+- AutoModelForCausalLMWithHydraValueHead + per-arch ModelBranch clones
+  (modeling_ppo.py:385-1222) -> `ref_param_subtree` + `forward_policy_and_ref`
+  (one jit graph computes policy logits, values, and frozen-reference logits;
+  no module surgery, no second full forward over the trunk)
+- freeze_bottom_causal_layers (utils/modeling.py:22-38)
+    -> `trainable_mask` consumed by optax.masked / stop-gradient
+- AutoModelForCausalLMWithILQLHeads (modeling_ilql.py:325-412)
+    -> CausalLMWithILQLHeads (Q-guided sampling lives in ops/sampling.py
+       as a logit-processor hook instead of a duplicated generate loop)
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.heads import ILQLHeads, MLPHead
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+class CausalLMWithValueHead(nn.Module):
+    cfg: TransformerConfig
+
+    def setup(self):
+        self.lm = TransformerLM(self.cfg, name="lm")
+        self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
+
+    def __call__(self, tokens, attn_mask, positions=None, split: int = 0):
+        """Returns (logits, values, h_split). `split` is the hydra branch
+        point (0 = no split; h_split is then the embedding output)."""
+        logits, h_split, h_final = self.lm(tokens, attn_mask, positions, split)
+        values = self.v_head(h_final)[..., 0]
+        return logits, values, h_split
+
+    def forward_ref_suffix(self, h_split, attn_mask, positions=None, start_layer: int = 0):
+        """Frozen-branch pass from the split point (apply with ref params)."""
+        return self.lm.forward_from(h_split, attn_mask, positions, start_layer)
+
+    def forward_ref_full(self, tokens, attn_mask, positions=None):
+        """Full reference forward (used when every layer is trainable)."""
+        logits, _, _ = self.lm(tokens, attn_mask, positions, 0)
+        return logits
+
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False, with_value: bool = False):
+        logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
+        if with_value:
+            return logits, self.v_head(h)[..., 0], new_cache
+        return logits, None, new_cache
+
+
+class CausalLMWithILQLHeads(nn.Module):
+    cfg: TransformerConfig
+    two_qs: bool = True
+
+    def setup(self):
+        self.lm = TransformerLM(self.cfg, name="lm")
+        self.ilql_heads = ILQLHeads(
+            self.cfg.vocab_size, self.two_qs, self.cfg.dtype, self.cfg.param_dtype, name="ilql_heads"
+        )
+
+    def __call__(self, tokens, attn_mask, positions=None, states_ixs=None, actions_ixs=None):
+        logits, _, h_final = self.lm(tokens, attn_mask, positions, 0)
+        qs, target_qs, vs = self.ilql_heads(h_final, states_ixs, actions_ixs)
+        return logits, qs, target_qs, vs, h_final
+
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False):
+        """Cached decode returning (logits, qs, target_qs, vs, cache) at the
+        new positions — feeds the beta*(Q-V) logit shift during generation."""
+        logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
+        qs, target_qs, vs = self.ilql_heads(h)
+        return logits, qs, target_qs, vs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Param-tree utilities (freezing / hydra reference branch)
+# ---------------------------------------------------------------------------
+
+
+def resolve_split(cfg: TransformerConfig, num_layers_unfrozen: int) -> int:
+    """Map the user-facing `num_layers_unfrozen` to the hydra split layer.
+    Semantics match the reference's freeze_bottom_causal_layers
+    (utils/modeling.py:22-38): -1 = everything trainable (split 0 with a
+    full reference copy), 0 = whole LM frozen (heads-only training; split
+    n_layers, ref branch is just the frozen unembedding), k>0 = top k
+    blocks trainable."""
+    if num_layers_unfrozen == -1:
+        return 0
+    if num_layers_unfrozen == 0:
+        return cfg.n_layers
+    return max(cfg.n_layers - num_layers_unfrozen, 0)
+
+
+def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
+    """Extract (a copy of) the params the reference branch needs.
+
+    split > 0: blocks[split:], ln_f, and the unembedding (tied embedding or
+    lm_head) — everything below the split is frozen and shared live, which
+    is exactly the reference's hydra invariant (modeling_ppo.py:400-408).
+    split == 0: the whole LM (a standalone frozen reference model)."""
+    lm = params["lm"]
+    if split == 0:
+        return jax.tree_util.tree_map(lambda x: x, lm)
+    subtree = {}
+    for i in range(split, cfg.n_layers):
+        subtree[f"block_{i}"] = lm[f"block_{i}"]
+    subtree["ln_f"] = lm["ln_f"]
+    if cfg.tie_embeddings:
+        subtree["embed_tokens"] = lm["embed_tokens"]
+    else:
+        subtree["lm_head"] = lm["lm_head"]
+    return jax.tree_util.tree_map(lambda x: x, subtree)
+
+
+def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: int) -> Dict:
+    """Bool pytree: True where the param is trainable. Heads are always
+    trainable; `num_layers_unfrozen` follows reference semantics
+    (-1 all LM params, 0 none, k>0 top-k blocks + final norm)."""
+    split = resolve_split(cfg, num_layers_unfrozen)
+
+    def _mask(path_keys, leaf):
+        parts = [getattr(k, "key", str(k)) for k in path_keys]
+        if parts[0] != "lm":
+            return True  # v_head / ilql_heads / any auxiliary head
+        if num_layers_unfrozen == -1:
+            return True
+        if num_layers_unfrozen == 0:
+            return False
+        name = parts[1]
+        if name.startswith("block_"):
+            return int(name.split("_")[1]) >= split
+        # embed_tokens / embed_pos / lm_head / ln_f
+        return name == "ln_f"
+
+    return jax.tree_util.tree_map_with_path(_mask, params)
+
+
+def target_q_mask(params: Dict) -> Dict:
+    """Bool pytree: True for target-Q-head params (excluded from the
+    optimizer; updated only by Polyak sync)."""
+
+    def _mask(path_keys, leaf):
+        parts = [getattr(k, "key", str(k)) for k in path_keys]
+        return any(str(p).startswith("target_q_head") for p in parts)
+
+    return jax.tree_util.tree_map_with_path(_mask, params)
+
+
+def apply_trainable_mask(mask: Dict, exclude: Dict) -> Dict:
+    """AND a trainable mask with NOT exclude (e.g. drop target-Q heads)."""
+    return jax.tree_util.tree_map(lambda m, e: bool(m) and not bool(e), mask, exclude)
+
+
+def forward_policy_and_ref(
+    model: CausalLMWithValueHead,
+    params: Dict,
+    ref_params: Dict,
+    tokens: jnp.ndarray,
+    attn_mask: jnp.ndarray,
+    split: int,
+    positions: Optional[jnp.ndarray] = None,
+):
+    """Policy logits + values + frozen-reference logits in ONE compiled
+    graph. The trunk below `split` runs once; the reference runs only the
+    cloned top branch (or, when split == 0, a full pass with the reference
+    copy). The reference framework needs two or three separate module
+    forwards for this (accelerate_ppo_trainer.py:414-438)."""
+    logits, values, h_split = model.apply(
+        {"params": params}, tokens, attn_mask, positions, split
+    )
+    if split > 0:
+        ref_logits = model.apply(
+            {"params": {"lm": ref_params}},
+            jax.lax.stop_gradient(h_split),
+            attn_mask,
+            positions,
+            split,
+            method=CausalLMWithValueHead.forward_ref_suffix,
+        )
+    else:
+        ref_logits = model.apply(
+            {"params": {"lm": ref_params}},
+            tokens,
+            attn_mask,
+            positions,
+            method=CausalLMWithValueHead.forward_ref_full,
+        )
+    return logits, values, jax.lax.stop_gradient(ref_logits)

@@ -1,0 +1,361 @@
+"""TPU-native transformer language model (Flax).
+
+This single module covers the model families the reference wraps via HF
+per-architecture classes and re-implemented decoder loops
+(GPTModelBranch/OPTModelBranch/LlamaModelBranch/... in
+trlx/models/modeling_ppo.py:502-1222): one `TransformerLM` parameterized by
+`TransformerConfig` expresses GPT-2-style (learned positions, LayerNorm,
+gelu, tied embeddings) and Llama-style (rotary positions, RMSNorm, swiglu,
+GQA) decoders. The per-arch "branch" classes collapse to a `start_layer`
+argument: `__call__(..., split=k)` also returns the hidden state entering
+block k, and `forward_from(h, start_layer=k)` resumes from there — applied
+with a frozen copy of the top-k params this IS the reference's hydra branch
+(modeling_ppo.py:385-499), but in the same jit graph as the policy pass.
+
+Decode uses a functional KV cache (static max length, dynamic_update_slice
+writes) so the sampling loop is a single compiled lax.while_loop.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    d_ff: int
+    n_kv_heads: Optional[int] = None  # GQA/MQA; None = n_heads
+    max_seq_len: int = 2048
+    pos_embed: str = "learned"  # "learned" | "rope" | "none"
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "silu" (silu => swiglu MLP)
+    glu: bool = False  # gated MLP (llama-style)
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    layer_norm_epsilon: float = 1e-5
+    use_bias: bool = True  # dense biases (gpt2 yes, llama no)
+    dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
+    param_dtype: Any = jnp.float32
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def make_norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> np.ndarray:
+    return 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary position embedding. x: [b, t, h, hd], positions: [b, t]."""
+    hd = x.shape[-1]
+    freqs = jnp.asarray(rope_frequencies(hd, theta))  # [hd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, t, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [b, t, 1, hd/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        h: jnp.ndarray,  # [b, t, d]
+        attn_bias: jnp.ndarray,  # [b, 1, t, S] additive
+        positions: jnp.ndarray,  # [b, t]
+        layer_cache: Optional[Dict[str, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
+    ):
+        cfg = self.cfg
+        b, t, d = h.shape
+        nh, nkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        q = dense(nh * hd, "q_proj")(h).reshape(b, t, nh, hd)
+        k = dense(nkv * hd, "k_proj")(h).reshape(b, t, nkv, hd)
+        v = dense(nkv * hd, "v_proj")(h).reshape(b, t, nkv, hd)
+
+        if cfg.pos_embed == "rope":
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
+
+        new_cache = None
+        if layer_cache is not None:
+            # Write this step's K/V into the cache at cache_index, then attend
+            # over the whole (static-length) cache.
+            ck = jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
+            k, v = ck, cv
+            new_cache = {"k": ck, "v": cv}
+
+        if nkv != nh:  # GQA: repeat kv heads
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        scale = 1.0 / np.sqrt(hd)
+        # [b, h, t, S] — accumulate scores in f32 for stability.
+        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32) * scale
+        scores = scores + attn_bias  # bias is f32, -inf on masked
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        out = out.reshape(b, t, nh * hd)
+        out = dense(d, "o_proj")(out)
+        return out, new_cache
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+        if cfg.glu:
+            gated = act(dense(cfg.d_ff, "gate_proj")(h)) * dense(cfg.d_ff, "up_proj")(h)
+            return dense(cfg.d_model, "down_proj")(gated)
+        return dense(cfg.d_model, "down_proj")(act(dense(cfg.d_ff, "up_proj")(h)))
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None):
+        cfg = self.cfg
+        attn_out, new_cache = Attention(cfg, name="attn")(
+            make_norm(cfg, "ln_attn")(h), attn_bias, positions, layer_cache, cache_index
+        )
+        h = h + attn_out
+        h = h + MLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
+        return h, new_cache
+
+
+def causal_bias(attn_mask: jnp.ndarray) -> jnp.ndarray:
+    """Additive attention bias for training: causal + key-padding.
+    attn_mask: [b, t] (1 = real token). Returns [b, 1, t, t] f32."""
+    t = attn_mask.shape[-1]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    keymask = attn_mask[:, None, None, :].astype(bool)
+    allowed = causal[None, None, :, :] & keymask
+    return jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+
+
+def decode_bias(cache_mask: jnp.ndarray, t: int) -> jnp.ndarray:
+    """Bias during cached decode: attend to every valid cache slot.
+    cache_mask: [b, S] validity of cache slots (already includes the tokens
+    being written this step). For t>1 prefill the causal structure within
+    the new block is handled by the caller via causal_bias."""
+    allowed = cache_mask[:, None, None, :].astype(bool)
+    return jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM. Returns logits and (optionally) the hidden state at
+    a static split layer for the hydra reference branch."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed_tokens = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed_tokens"
+        )
+        if cfg.pos_embed == "learned":
+            self.embed_pos = nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed_pos"
+            )
+        self.blocks = [Block(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
+        self.ln_f = make_norm(cfg, "ln_f")
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
+            )
+
+    def embed(self, tokens, positions):
+        h = self.embed_tokens(tokens)
+        if self.cfg.pos_embed == "learned":
+            h = h + self.embed_pos(positions)
+        return h
+
+    def unembed(self, h):
+        """Final norm + output projection. Returns (logits, h_final) so the
+        value head can reuse the normed hidden state."""
+        h_final = self.ln_f(h)
+        if self.cfg.tie_embeddings:
+            logits = self.embed_tokens.attend(h_final)
+        else:
+            logits = self.lm_head(h_final)
+        return logits, h_final
+
+    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None):
+        new_layers = [] if cache is not None else None
+        for i in range(start, stop):
+            layer_cache = cache[i] if cache is not None else None
+            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index)
+            if cache is not None:
+                new_layers.append(new_cache)
+        return h, new_layers
+
+    def __call__(
+        self,
+        tokens: jnp.ndarray,  # [b, t]
+        attn_mask: jnp.ndarray,  # [b, t]
+        positions: Optional[jnp.ndarray] = None,
+        split: int = 0,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Training/scoring forward (no cache). Returns (logits, h_split,
+        h_final) where h_split is the activation entering block `split`."""
+        if positions is None:
+            positions = position_ids(attn_mask)
+        bias = causal_bias(attn_mask)
+        h = self.embed(tokens, positions)
+        h, _ = self.run_blocks(h, bias, positions, 0, split)
+        h_split = h
+        h, _ = self.run_blocks(h, bias, positions, split, self.cfg.n_layers)
+        logits, h_final = self.unembed(h)
+        return logits, h_split, h_final
+
+    def forward_from(
+        self,
+        h: jnp.ndarray,
+        attn_mask: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        start_layer: int = 0,
+    ) -> jnp.ndarray:
+        """Resume the forward pass from `start_layer` given its input hidden
+        state — the hydra frozen branch (reference forward_hydra,
+        modeling_ppo.py:410-453) when applied with reference params."""
+        if positions is None:
+            positions = position_ids(attn_mask)
+        bias = causal_bias(attn_mask)
+        h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers)
+        logits, _ = self.unembed(h)
+        return logits
+
+    def decode_step(
+        self,
+        tokens: jnp.ndarray,  # [b, t] (prefill) or [b, 1] (step)
+        cache: Dict[str, Any],
+        token_mask: jnp.ndarray,  # [b, t] validity of these tokens
+        is_prefill: bool = False,
+    ):
+        """One cached decode call. The cache pytree carries:
+        index (scalar write offset), mask [b, S], pos [b] (next position id
+        per row), layers (per-layer k/v)."""
+        b, t = tokens.shape
+        index = cache["index"]
+        # positions of the incoming tokens
+        if is_prefill:
+            positions = position_ids(token_mask)
+            next_pos = token_mask.sum(-1).astype(jnp.int32)
+        else:
+            positions = cache["pos"][:, None]
+            next_pos = cache["pos"] + token_mask[:, 0].astype(jnp.int32)
+        new_mask = jax.lax.dynamic_update_slice(
+            cache["mask"], token_mask.astype(cache["mask"].dtype), (0, index)
+        )
+        bias = decode_bias(new_mask, t)
+        if is_prefill:
+            # causal structure within the prefill block
+            S = cache["mask"].shape[-1]
+            q_ids = jnp.arange(t)[:, None]
+            k_ids = jnp.arange(S)[None, :]
+            within = (k_ids < index + t) & (k_ids >= index) & (k_ids - index > q_ids)
+            bias = bias + jnp.where(within[None, None], -1e9, 0.0).astype(jnp.float32)
+
+        h = self.embed(tokens, positions)
+        h, new_layers = self.run_blocks(
+            h, bias, positions, 0, self.cfg.n_layers, cache=cache["layers"], cache_index=index
+        )
+        logits, h = self.unembed(h)
+        new_cache = {
+            "index": index + t,
+            "mask": new_mask,
+            "pos": next_pos,
+            "layers": new_layers,
+        }
+        return logits, h, new_cache
+
+
+def position_ids(attn_mask: jnp.ndarray) -> jnp.ndarray:
+    """Position ids robust to left padding: cumsum of the mask - 1, clipped
+    (mirrors the reference's position_ids computation,
+    accelerate_ppo_trainer.py:176-180)."""
+    return jnp.clip(jnp.cumsum(attn_mask.astype(jnp.int32), axis=-1) - 1, 0, None)
+
+
+def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int, dtype=None):
+    """Allocate an empty functional KV cache."""
+    dtype = dtype or cfg.dtype
+    layers = [
+        {
+            "k": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), dtype=dtype),
+            "v": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), dtype=dtype),
+        }
+        for _ in range(cfg.n_layers)
+    ]
+    return {
+        "index": jnp.asarray(0, dtype=jnp.int32),
+        "mask": jnp.zeros((batch_size, max_len), dtype=jnp.int32),
+        "pos": jnp.zeros((batch_size,), dtype=jnp.int32),
+        "layers": layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Model family presets
+# ---------------------------------------------------------------------------
+
+PRESETS: Dict[str, Dict[str, Any]] = {
+    # tiny from-scratch models for tests/benchmarks ("random:" prefix)
+    "gpt2-tiny": dict(d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256),
+    "gpt2-small": dict(d_model=768, n_layers=12, n_heads=12, d_ff=3072, max_seq_len=1024),
+    "gpt2-medium": dict(d_model=1024, n_layers=24, n_heads=16, d_ff=4096, max_seq_len=1024),
+    "gpt2-large": dict(d_model=1280, n_layers=36, n_heads=20, d_ff=5120, max_seq_len=1024),
+    "gpt2-xl": dict(d_model=1600, n_layers=48, n_heads=25, d_ff=6400, max_seq_len=1024),
+    "llama-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, max_seq_len=256,
+        pos_embed="rope", norm="rmsnorm", activation="silu", glu=True,
+        tie_embeddings=False, use_bias=False,
+    ),
+    "llama-7b": dict(
+        d_model=4096, n_layers=32, n_heads=32, d_ff=11008, max_seq_len=4096,
+        pos_embed="rope", norm="rmsnorm", activation="silu", glu=True,
+        tie_embeddings=False, use_bias=False,
+    ),
+}
+
+
+def config_from_preset(name: str, vocab_size: int, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise ValueError(f"Unknown model preset '{name}'. Available: {sorted(PRESETS)}")
+    kwargs = dict(PRESETS[name])
+    kwargs.update(overrides)
+    return TransformerConfig(vocab_size=vocab_size, **kwargs)

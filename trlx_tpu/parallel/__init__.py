@@ -1,0 +1,20 @@
+"""Parallelism runtime: device mesh, sharding rules, collective helpers.
+
+This module replaces the reference's two distributed backends (HF Accelerate
+DDP/DeepSpeed-ZeRO and NeMo-Megatron TP/PP/SP over NCCL/Apex,
+SURVEY.md §2.6-2.7) with a single GSPMD device mesh: DP, FSDP (ZeRO), TP
+and SP are axis assignments on one `jax.sharding.Mesh`, and every
+collective is expressed inside jit-compiled programs so XLA schedules it
+over ICI/DCN.
+"""
+
+from trlx_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshRuntime,
+    make_mesh,
+)
+from trlx_tpu.parallel.sharding import (  # noqa: F401
+    ShardingRules,
+    batch_sharding,
+    infer_param_shardings,
+)

@@ -1,0 +1,137 @@
+"""Device mesh construction.
+
+The mesh has up to four axes — ("data", "fsdp", "tensor", "sequence") —
+which together express every parallelism strategy the reference ships
+(SURVEY.md §2.7): pure DP (Accelerate DDP), ZeRO-sharded DP (DeepSpeed →
+"fsdp" axis), megatron TP ("tensor"), and sequence/context parallelism
+("sequence", which the reference only has as Megatron SP inside a TP
+group). Pipeline parallelism is handled separately via stage-sharded
+`shard_map` (trlx_tpu/parallel/pipeline.py).
+
+Batches are sharded over ("data", "fsdp") jointly — fsdp is just DP that
+additionally shards params/optimizer state — so global batch = per-shard
+batch x data x fsdp.
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+MESH_AXES = ("data", "fsdp", "tensor", "sequence")
+
+
+def _resolve_axis_sizes(n_devices: int, sizes: Sequence[int]) -> Tuple[int, ...]:
+    """Resolve -1 entries to soak up remaining devices (at most one -1)."""
+    sizes = list(sizes)
+    known = 1
+    unknown = []
+    for i, s in enumerate(sizes):
+        if s == -1:
+            unknown.append(i)
+        else:
+            known *= s
+    if len(unknown) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {sizes}")
+    if unknown:
+        if n_devices % known != 0:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes {sizes}")
+        sizes[unknown[0]] = n_devices // known
+    total = int(np.prod(sizes))
+    if total != n_devices:
+        raise ValueError(
+            f"Mesh axes {dict(zip(MESH_AXES, sizes))} use {total} devices, "
+            f"but {n_devices} are available"
+        )
+    return tuple(sizes)
+
+
+def make_mesh(
+    data: int = -1,
+    fsdp: int = 1,
+    tensor: int = 1,
+    sequence: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    Device order matters for ICI locality: `mesh_utils.create_device_mesh`
+    lays axes out so the innermost (tensor/sequence) axes map to
+    nearest-neighbor ICI links, keeping TP all-reduces and ring-attention
+    ppermutes off DCN.
+    """
+    devices = devices if devices is not None else jax.devices()
+    sizes = _resolve_axis_sizes(len(devices), [data, fsdp, tensor, sequence])
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:  # CPU/host meshes without topology info
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+@dataclass
+class MeshRuntime:
+    """Holds the mesh plus convenience shardings; the single object trainers
+    use for device placement (the counterpart of the reference's
+    `Accelerator` + apex `parallel_state`, SURVEY.md §5.8)."""
+
+    mesh: Mesh
+
+    @classmethod
+    def from_config(cls, parallel_config, devices=None) -> "MeshRuntime":
+        if getattr(parallel_config, "pipeline", 1) not in (1, None):
+            raise NotImplementedError(
+                "parallel.pipeline > 1 is not implemented yet; use "
+                "data/fsdp/tensor/sequence axes"
+            )
+        mesh = make_mesh(
+            data=parallel_config.data,
+            fsdp=parallel_config.fsdp,
+            tensor=parallel_config.tensor,
+            sequence=parallel_config.sequence,
+            devices=devices,
+        )
+        logger.info(f"Device mesh: {dict(zip(MESH_AXES, mesh.devices.shape))}")
+        return cls(mesh=mesh)
+
+    @property
+    def dp_size(self) -> int:
+        """Total data-parallel ways (data x fsdp axes)."""
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return shape["data"] * shape["fsdp"]
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        """Shard the batch dim over all data-parallel axes."""
+        return self.sharding(("data", "fsdp"))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    def shard_batch(self, batch):
+        """Place a host batch pytree onto the mesh, batch-dim sharded.
+        Non-array leaves pass through untouched."""
+        sharding = self.batch_sharding
+
+        def _place(x):
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1:
+                return jax.device_put(np.asarray(x), sharding)
+            return x
+
+        return jax.tree_util.tree_map(_place, batch)

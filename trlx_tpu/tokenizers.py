@@ -1,0 +1,213 @@
+"""Tokenizer abstraction.
+
+The reference leans on HF `AutoTokenizer` everywhere
+(accelerate_base_trainer.py:66-75). Here we define a minimal uniform
+interface with three implementations:
+
+- `HFTokenizer` — adapter over a transformers tokenizer (used when the
+  checkpoint/tokenizer is available locally; this environment has no
+  network egress, so it's optional);
+- `ByteTokenizer` — offline-friendly byte-level tokenizer (256 bytes +
+  specials), usable with any text;
+- `CharTokenizer` — small fixed-alphabet tokenizer for synthetic tasks
+  (e.g. the randomwalks benchmark, reference examples/randomwalks/).
+
+`tokenizer_path` dispatch: "byte" / "byte:" → ByteTokenizer,
+"char:<alphabet>" → CharTokenizer, anything else → HFTokenizer.
+"""
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+class BaseTokenizer:
+    """Minimal tokenizer interface the trainers rely on."""
+
+    eos_token_id: int
+    pad_token_id: int
+    bos_token_id: Optional[int]
+    vocab_size: int
+    padding_side: str = "left"
+    truncation_side: str = "right"
+    eos_token: str = ""
+    bos_token: str = ""
+
+    def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        raise NotImplementedError
+
+    def batch_decode(self, batch_ids, skip_special_tokens: bool = True) -> List[str]:
+        return [self.decode(ids, skip_special_tokens) for ids in batch_ids]
+
+    def __call__(
+        self,
+        text: Union[str, List[str]],
+        max_length: Optional[int] = None,
+        truncation: bool = False,
+        padding: Union[bool, str] = False,
+        add_special_tokens: bool = True,
+    ) -> Dict[str, list]:
+        """HF-style call: returns {"input_ids": ..., "attention_mask": ...}
+        as python lists (unpadded) or numpy arrays (padded)."""
+        if isinstance(text, str):
+            out = self([text], max_length, truncation, padding, add_special_tokens)
+            return {k: v[0] for k, v in out.items()}
+
+        seqs = [self.encode(t, add_special_tokens=add_special_tokens) for t in text]
+        if truncation and max_length is not None:
+            if self.truncation_side == "right":
+                seqs = [s[:max_length] for s in seqs]
+            else:
+                seqs = [s[-max_length:] for s in seqs]
+
+        if padding:
+            length = max_length if padding == "max_length" and max_length else max(
+                (len(s) for s in seqs), default=0
+            )
+            ids = np.full((len(seqs), length), self.pad_token_id, dtype=np.int32)
+            mask = np.zeros((len(seqs), length), dtype=np.int32)
+            for i, s in enumerate(seqs):
+                if self.padding_side == "left":
+                    ids[i, length - len(s):] = s
+                    mask[i, length - len(s):] = 1
+                else:
+                    ids[i, : len(s)] = s
+                    mask[i, : len(s)] = 1
+            return {"input_ids": ids, "attention_mask": mask}
+
+        return {
+            "input_ids": seqs,
+            "attention_mask": [[1] * len(s) for s in seqs],
+        }
+
+
+class ByteTokenizer(BaseTokenizer):
+    """UTF-8 byte-level tokenizer: ids 0..255 are bytes; 256=pad, 257=bos,
+    258=eos. Fully offline; round-trips arbitrary text."""
+
+    def __init__(self, padding_side: str = "left", truncation_side: str = "right"):
+        self.pad_token_id = 256
+        self.bos_token_id = 257
+        self.eos_token_id = 258
+        self.vocab_size = 259
+        self.padding_side = padding_side
+        self.truncation_side = truncation_side
+        self.eos_token = "<|eos|>"
+        self.bos_token = "<|bos|>"
+        self.name_or_path = "byte"
+
+    def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        if add_eos:
+            ids.append(self.eos_token_id)
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        ids = [int(i) for i in np.asarray(ids).reshape(-1)]
+        if skip_special_tokens:
+            byte_vals = [i for i in ids if i < 256]
+        else:
+            byte_vals = []
+            for i in ids:
+                if i < 256:
+                    byte_vals.append(i)
+                elif i == self.eos_token_id:
+                    byte_vals.extend(self.eos_token.encode())
+                elif i == self.bos_token_id:
+                    byte_vals.extend(self.bos_token.encode())
+        return bytes(byte_vals).decode("utf-8", errors="replace")
+
+
+class CharTokenizer(BaseTokenizer):
+    """Fixed-alphabet character tokenizer for synthetic benchmarks."""
+
+    def __init__(
+        self,
+        alphabet: str,
+        padding_side: str = "left",
+        truncation_side: str = "right",
+    ):
+        self.alphabet = alphabet
+        self.char_to_id = {c: i for i, c in enumerate(alphabet)}
+        n = len(alphabet)
+        self.pad_token_id = n
+        self.bos_token_id = n + 1
+        self.eos_token_id = n + 2
+        self.vocab_size = n + 3
+        self.padding_side = padding_side
+        self.truncation_side = truncation_side
+        self.eos_token = "="  # single printable char so decoded evals read cleanly
+        self.bos_token = "^"
+        self.name_or_path = f"char:{alphabet}"
+
+    def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
+        ids = [self.char_to_id[c] for c in text if c in self.char_to_id]
+        if add_eos:
+            ids.append(self.eos_token_id)
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        ids = [int(i) for i in np.asarray(ids).reshape(-1)]
+        chars = []
+        for i in ids:
+            if i < len(self.alphabet):
+                chars.append(self.alphabet[i])
+            elif not skip_special_tokens:
+                if i == self.eos_token_id:
+                    chars.append(self.eos_token)
+                elif i == self.bos_token_id:
+                    chars.append(self.bos_token)
+        return "".join(chars)
+
+
+class HFTokenizer(BaseTokenizer):
+    """Adapter over a transformers tokenizer (reference behavior:
+    pad=eos when missing, accelerate_base_trainer.py:72-75)."""
+
+    def __init__(
+        self,
+        path: str,
+        padding_side: str = "left",
+        truncation_side: str = "right",
+        **kwargs,
+    ):
+        from transformers import AutoTokenizer
+
+        self.tk = AutoTokenizer.from_pretrained(path, **kwargs)
+        self.tk.padding_side = padding_side
+        self.tk.truncation_side = truncation_side
+        if self.tk.pad_token is None:
+            self.tk.pad_token = "<|padding|>" if self.tk.eos_token is None else self.tk.eos_token
+        self.padding_side = padding_side
+        self.truncation_side = truncation_side
+        self.pad_token_id = self.tk.pad_token_id
+        self.eos_token_id = self.tk.eos_token_id
+        self.bos_token_id = self.tk.bos_token_id
+        self.vocab_size = len(self.tk)
+        self.eos_token = self.tk.eos_token or ""
+        self.bos_token = self.tk.bos_token or ""
+        self.name_or_path = path
+
+    def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
+        ids = self.tk(text, add_special_tokens=add_special_tokens)["input_ids"]
+        if add_eos and (not ids or ids[-1] != self.eos_token_id):
+            ids.append(self.eos_token_id)
+        return ids
+
+    def decode(self, ids, skip_special_tokens: bool = True) -> str:
+        ids = np.asarray(ids).reshape(-1).tolist()
+        return self.tk.decode(ids, skip_special_tokens=skip_special_tokens)
+
+
+def get_tokenizer(config) -> BaseTokenizer:
+    """Build a tokenizer from a TokenizerConfig (trlx_tpu/data/configs.py)."""
+    path = config.tokenizer_path
+    kwargs = dict(config.tokenizer_extra_configs or {})
+    if path in ("byte", "byte:"):
+        return ByteTokenizer(config.padding_side, config.truncation_side)
+    if path.startswith("char:"):
+        return CharTokenizer(path[len("char:"):], config.padding_side, config.truncation_side)
+    return HFTokenizer(path, config.padding_side, config.truncation_side, **kwargs)

@@ -1,0 +1,64 @@
+"""Trainer registry and abstract base trainer.
+
+Parity: trlx/trainer/__init__.py (register_trainer/_TRAINERS,
+BaseRLTrainer holding store/config/reward_fn/metric_fn/stop_sequences,
+push_to_store, abstract learn()).
+"""
+
+import sys
+from abc import abstractmethod
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.pipeline import BaseRolloutStore
+
+# Trainer registry, keyed by lowercased class name.
+_TRAINERS: Dict[str, Any] = {}
+
+
+def register_trainer(name):
+    """Decorator to register a trainer class (reference trainer/__init__.py:9-31)."""
+
+    def register_class(cls, name):
+        _TRAINERS[name] = cls
+        setattr(sys.modules[__name__], name, cls)
+        return cls
+
+    if isinstance(name, str):
+        name = name.lower()
+        return lambda c: register_class(c, name)
+
+    cls = name
+    register_class(cls, cls.__name__.lower())
+    return cls
+
+
+@register_trainer
+class BaseRLTrainer:
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn: Optional[Callable] = None,
+        metric_fn: Optional[Callable] = None,
+        logit_mask=None,
+        stop_sequences: Optional[List[str]] = None,
+        **kwargs,
+    ):
+        self.store: BaseRolloutStore = None
+        self.config = config
+        self.reward_fn = reward_fn
+        self.metric_fn = metric_fn
+        self.logit_mask = logit_mask
+        self.stop_sequences = stop_sequences
+
+    def push_to_store(self, data):
+        self.store.push(data)
+
+    def add_eval_pipeline(self, eval_pipeline):
+        """Set the evaluation pipeline used during evaluate()."""
+        self.eval_pipeline = eval_pipeline
+
+    @abstractmethod
+    def learn(self):
+        """Train the model and periodically evaluate on eval prompts."""
+        pass

@@ -1,0 +1,205 @@
+"""General utilities: seeding, timing, optax optimizer/scheduler registries,
+pytree helpers, iterator helpers.
+
+Parity: trlx/utils/__init__.py in the reference (set_seed, Clock,
+OptimizerName/SchedulerName + getters, significant, infinite_dataloader) —
+rebuilt on numpy/JAX PRNG and optax instead of torch.
+"""
+
+import math
+import random
+import time
+from enum import Enum
+from numbers import Number
+from typing import Any, Dict, Iterable, Tuple
+
+import numpy as np
+import optax
+
+
+def significant(x: Number, ndigits: int = 2) -> Number:
+    """Cut the number up to its `ndigits` after the most significant digit."""
+    if isinstance(x, Number) and not isinstance(x, bool) and x != 0 and math.isfinite(x):
+        return round(x, ndigits - int(math.floor(math.log10(abs(x)))))
+    return x
+
+
+def set_seed(seed: int) -> int:
+    """Seed host-side RNGs (python, numpy). Device randomness in JAX is
+    explicit via PRNG keys derived from this seed; per-data-parallel-rank
+    keys are produced with `jax.random.fold_in(key, rank)` (the reference
+    instead re-seeds torch per rank, trlx/utils/__init__.py:44-56)."""
+    import jax
+
+    seed = int(seed) + jax.process_index()
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return seed
+
+
+class Clock:
+    """Wall-clock throughput meter: tick() returns ms since the last tick and
+    accumulates time/samples for get_stat(). Mirrors reference Clock
+    (trlx/utils/__init__.py:149-187)."""
+
+    def __init__(self):
+        self.start = time.time()
+        self.total_time = 0
+        self.total_samples = 0
+
+    def tick(self, samples: int = 0) -> float:
+        end = time.time()
+        delta = end - self.start
+        self.start = end
+        if samples != 0:
+            self.total_time += delta
+            self.total_samples += samples
+        return delta * 1000
+
+    def get_stat(self, n_samp: int = 1000, reset: bool = False) -> float:
+        """Average milliseconds per n_samp samples."""
+        sec_per_samp = self.total_time / max(self.total_samples, 1)
+        if reset:
+            self.total_time = 0
+            self.total_samples = 0
+        return sec_per_samp * n_samp * 1000
+
+
+def infinite_dataloader(dataloader: Iterable) -> Iterable:
+    """Yield batches forever, restarting the loader at exhaustion."""
+    while True:
+        yield from dataloader
+
+
+# ---------------------------------------------------------------------------
+# Optimizers (optax)
+# ---------------------------------------------------------------------------
+
+
+class OptimizerName(str, Enum):
+    """Supported optimizer names (reference: trlx/utils/__init__.py:83-101;
+    the bitsandbytes 8-bit variants map to plain optax counterparts here)."""
+
+    ADAM = "adam"
+    ADAMW = "adamw"
+    ADAM_8BIT_BNB = "adam_8bit_bnb"
+    ADAMW_8BIT_BNB = "adamw_8bit_bnb"
+    SGD = "sgd"
+    LION = "lion"
+    RMSPROP = "rmsprop"
+
+
+def get_optimizer(
+    name: str,
+    learning_rate,
+    kwargs: Dict[str, Any] = None,
+) -> optax.GradientTransformation:
+    """Build an optax optimizer from a torch-style kwargs dict
+    (lr/betas/eps/weight_decay). `learning_rate` may be a float or an optax
+    schedule; it overrides kwargs['lr'] when given."""
+    kwargs = dict(kwargs or {})
+    kwargs.pop("lr", None)
+    betas = kwargs.pop("betas", (0.9, 0.999))
+    eps = kwargs.pop("eps", 1e-8)
+    weight_decay = kwargs.pop("weight_decay", 0.0)
+    momentum = kwargs.pop("momentum", 0.9)
+
+    name = OptimizerName(name.lower())
+    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT_BNB):
+        return optax.adamw(
+            learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay, **kwargs
+        )
+    if name in (OptimizerName.ADAM, OptimizerName.ADAM_8BIT_BNB):
+        return optax.adam(learning_rate, b1=betas[0], b2=betas[1], eps=eps, **kwargs)
+    if name == OptimizerName.SGD:
+        return optax.sgd(learning_rate, momentum=momentum, **kwargs)
+    if name == OptimizerName.LION:
+        return optax.lion(learning_rate, b1=betas[0], b2=betas[1], weight_decay=weight_decay)
+    if name == OptimizerName.RMSPROP:
+        return optax.rmsprop(learning_rate, eps=eps, momentum=momentum, **kwargs)
+    raise ValueError(f"{name} is not a supported optimizer")
+
+
+# ---------------------------------------------------------------------------
+# LR schedules (optax)
+# ---------------------------------------------------------------------------
+
+
+class SchedulerName(str, Enum):
+    """Supported scheduler names (reference: trlx/utils/__init__.py:129-146)."""
+
+    COSINE_ANNEALING = "cosine_annealing"
+    LINEAR = "linear"
+    CONSTANT = "constant"
+    COSINE_WARMUP = "cosine_warmup"
+
+
+def get_scheduler(name: str, base_lr: float, kwargs: Dict[str, Any] = None):
+    """Build an optax schedule. `cosine_annealing(T_max, eta_min)` matches
+    torch CosineAnnealingLR semantics used by the reference configs."""
+    kwargs = dict(kwargs or {})
+    name = SchedulerName(name.lower())
+    if name == SchedulerName.COSINE_ANNEALING:
+        t_max = float(kwargs.get("T_max", 1e12))
+        eta_min = float(kwargs.get("eta_min", 0.0))
+
+        def schedule(step):
+            import jax.numpy as jnp
+
+            frac = jnp.clip(step / t_max, 0.0, 1.0)
+            return eta_min + 0.5 * (base_lr - eta_min) * (1 + jnp.cos(jnp.pi * frac))
+
+        return schedule
+    if name == SchedulerName.LINEAR:
+        total = int(kwargs.get("total_iters", kwargs.get("T_max", 10000)))
+        end = float(kwargs.get("eta_min", 0.0))
+        return optax.linear_schedule(base_lr, end, total)
+    if name == SchedulerName.CONSTANT:
+        return optax.constant_schedule(base_lr)
+    if name == SchedulerName.COSINE_WARMUP:
+        warmup = int(kwargs.get("warmup_steps", 100))
+        total = int(kwargs.get("T_max", 10000))
+        eta_min = float(kwargs.get("eta_min", 0.0))
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=base_lr,
+            warmup_steps=warmup,
+            decay_steps=total,
+            end_value=eta_min,
+        )
+    raise ValueError(f"{name} is not a supported scheduler")
+
+
+# ---------------------------------------------------------------------------
+# Pytree / dict helpers
+# ---------------------------------------------------------------------------
+
+
+def flatten_dict(d: Dict, parent_key: str = "", sep: str = "/") -> Dict:
+    """Flatten a nested dict into one level with `sep`-joined keys."""
+    items = []
+    for k, v in d.items():
+        new_key = parent_key + sep + str(k) if parent_key else str(k)
+        if isinstance(v, dict):
+            items.extend(flatten_dict(v, new_key, sep=sep).items())
+        else:
+            items.append((new_key, v))
+    return dict(items)
+
+
+def to_scalar_stats(stats: Dict[str, Any]) -> Dict[str, float]:
+    """Convert a flat stats dict of device scalars/arrays to python floats."""
+    out = {}
+    for k, v in stats.items():
+        try:
+            out[k] = float(np.asarray(v))
+        except (TypeError, ValueError):
+            out[k] = v
+    return out
+
+
+def print_rank_0(*args, **kwargs):
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)

@@ -1,0 +1,59 @@
+"""Name-keyed lookup of registered trainers and pipelines.
+
+Parity: trlx/utils/loading.py. Importing this module registers every
+built-in trainer/pipeline (the registries fill on import).
+"""
+
+from trlx_tpu.pipeline import _DATAPIPELINE
+from trlx_tpu.trainer import _TRAINERS
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# Importing these modules fills the registries. Individual imports degrade
+# gracefully (mirroring the reference's NeMo ImportError stubs,
+# trlx/utils/loading.py:14-28) so a partially-built tree stays importable.
+for _mod in (
+    "trlx_tpu.pipeline.offline_pipeline",
+    "trlx_tpu.pipeline.ppo_pipeline",
+    "trlx_tpu.trainer.ppo_trainer",
+    "trlx_tpu.trainer.sft_trainer",
+    "trlx_tpu.trainer.ilql_trainer",
+    "trlx_tpu.trainer.rft_trainer",
+):
+    try:
+        __import__(_mod)
+    except ImportError as e:
+        logger.warning(f"Could not import {_mod}: {e}")
+
+
+def get_trainer(name: str):
+    """Return the constructor for a registered trainer."""
+    name = name.lower()
+    # Accept the reference's trainer names so user configs carry over
+    # (e.g. "AcceleratePPOTrainer" → PPOTrainer).
+    aliases = {
+        "accelerateppotrainer": "ppotrainer",
+        "accelerateilqltrainer": "ilqltrainer",
+        "acceleratesfttrainer": "sfttrainer",
+        "acceleraterfttrainer": "rfttrainer",
+        "nemoppotrainer": "ppotrainer",
+        "nemoilqltrainer": "ilqltrainer",
+        "nemosfttrainer": "sfttrainer",
+    }
+    name = aliases.get(name, name)
+    if name in _TRAINERS:
+        return _TRAINERS[name]
+    raise ValueError(
+        f"Trainer '{name}' is not registered. Available: {sorted(_TRAINERS)}"
+    )
+
+
+def get_pipeline(name: str):
+    """Return the constructor for a registered pipeline."""
+    name = name.lower()
+    if name in _DATAPIPELINE:
+        return _DATAPIPELINE[name]
+    raise ValueError(
+        f"Pipeline '{name}' is not registered. Available: {sorted(_DATAPIPELINE)}"
+    )

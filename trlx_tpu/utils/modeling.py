@@ -1,0 +1,134 @@
+"""Math / statistics helpers used by the RL losses and trainers.
+
+Parity: trlx/utils/modeling.py in the reference (whiten,
+get_global_statistics, logprobs_of_labels, RunningMoments, gather_dict).
+All device-side helpers are pure JAX functions. Under GSPMD/pjit a plain
+`jnp.mean` over a batch-sharded array already IS the global (cross-replica)
+mean — so unlike the reference, which needs explicit NCCL all_reduce inside
+`get_global_statistics` (utils/modeling.py:185-210), the "distributed"
+variants here are the same functions compiled under a mesh.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_head_init(scale: float = 0.0):
+    """Initializer for head output layers (reference initializes heads with
+    small normal; zero-init of final layer keeps values at 0 at start)."""
+    import flax.linen as nn
+
+    return nn.initializers.normal(stddev=scale) if scale > 0 else nn.initializers.zeros_init()
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    mask = mask.astype(x.dtype)
+    return (x * mask).sum(axis=axis) / jnp.maximum(mask.sum(axis=axis), 1.0)
+
+
+def masked_var(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    mean = masked_mean(x, mask)
+    return masked_mean((x - mean) ** 2, mask)
+
+
+def get_global_statistics(
+    xs: jnp.ndarray, mask: Optional[jnp.ndarray] = None
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(mean, var, count) of `xs`. Inside a pjit-compiled program over a
+    mesh these reductions are global automatically (XLA inserts the
+    collectives the reference does by hand at utils/modeling.py:185-196)."""
+    if mask is None:
+        mask = jnp.ones_like(xs)
+    mask = mask.astype(xs.dtype)
+    count = mask.sum()
+    global_sum = (xs * mask).sum()
+    mean = global_sum / jnp.maximum(count, 1.0)
+    var = ((xs - mean) ** 2 * mask).sum() / jnp.maximum(count, 1.0)
+    return mean, var, count
+
+
+def whiten(
+    xs: jnp.ndarray,
+    shift_mean: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Normalize to zero mean, unit variance (reference utils/modeling.py:200-210)."""
+    mean, var, _ = get_global_statistics(xs, mask)
+    whitened = (xs - mean) * jax.lax.rsqrt(var + 1e-8)
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened
+
+
+def logprobs_of_labels(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Log-probabilities of `labels` under `logits` (reference
+    utils/modeling.py:1??: log_softmax + gather). logits: [..., V],
+    labels: [...] int. Computed in float32 for stability."""
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+
+
+def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    logits = logits.astype(jnp.float32)
+    pd = jax.nn.softmax(logits, axis=-1)
+    return jax.scipy.special.logsumexp(logits, axis=-1) - (pd * logits).sum(-1)
+
+
+def get_tensor_stats(xs: jnp.ndarray, mask: jnp.ndarray, n: jnp.ndarray) -> Dict:
+    """mean/min/max/std over masked entries (reference utils/modeling.py)."""
+    mask = mask.astype(xs.dtype)
+    mean = (xs * mask).sum() / n
+    minimum = jnp.where(mask > 0, xs, jnp.inf).min()
+    maximum = jnp.where(mask > 0, xs, -jnp.inf).max()
+    std = jnp.sqrt((((xs - mean) * mask) ** 2).sum() / n)
+    return dict(mean=mean, min=minimum, max=maximum, std=std)
+
+
+class RunningMoments:
+    """Host-side running mean/std over batches of scores (Welford-style
+    parallel merge), matching reference RunningMoments
+    (trlx/utils/modeling.py:281-307). Used to scale rollout rewards."""
+
+    def __init__(self):
+        self.mean = 0.0
+        self.std = 1.0
+        self.var = 1.0
+        self.count = 1e-24
+
+    def update(self, xs: np.ndarray) -> Tuple[float, float]:
+        """Update from a batch (numpy or jax array, already globally
+        gathered); returns the batch's (mean, std)."""
+        xs = np.asarray(xs, dtype=np.float64)
+        xs_count = xs.size
+        xs_mean = xs.mean()
+        xs_var = xs.var()
+
+        delta = xs_mean - self.mean
+        tot_count = self.count + xs_count
+
+        new_sum = xs_var * xs_count
+        old_sum = self.var * self.count + delta**2 * self.count * xs_count / tot_count
+        tot_sum = old_sum + new_sum
+
+        self.mean += delta * xs_count / tot_count
+        self.var = tot_sum / tot_count
+        self.std = float(np.sqrt(self.var * tot_count / max(tot_count - 1, 1)))
+        self.count = tot_count
+
+        return float(xs_mean), float(np.sqrt(xs_var * xs_count / max(xs_count - 1, 1)))
+
+
+def gather_dict(obj: Dict, process_count: Optional[int] = None) -> Dict:
+    """Gather a dict of lists across hosts (reference utils/modeling.py:237-256
+    uses torch all_gather_object; here jax multihost_utils)."""
+    import jax
+
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(obj)
+    return gathered

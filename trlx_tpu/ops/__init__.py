@@ -1,0 +1,20 @@
+"""Pure-function compute ops: RL losses, advantage estimation, sampling.
+
+Everything here is jit-compatible JAX (static shapes, lax control flow) and
+free of host state — the algorithmic core the reference spreads across
+method configs and model classes (modeling_ppo.py / modeling_ilql.py).
+"""
+
+from trlx_tpu.ops.ppo import (  # noqa: F401
+    AdaptiveKLController,
+    FixedKLController,
+    get_advantages_and_returns,
+    ppo_loss,
+)
+from trlx_tpu.ops.ilql import batched_index_select, ilql_loss, topk_mask  # noqa: F401
+from trlx_tpu.ops.sampling import (  # noqa: F401
+    GenerationConfig,
+    generate,
+    make_generate_fn,
+    process_logits,
+)

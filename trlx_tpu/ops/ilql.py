@@ -1,0 +1,103 @@
+"""ILQL loss: Q-target fitting, expectile V regression, conservative
+Q-learning (CQL), and AWAC-weighted cross-entropy.
+
+Parity: trlx/models/modeling_ilql.py:94-166 (ILQLConfig.loss) and the
+helpers topk_mask (:29) / batched_index_select (:36). Same math, pure JAX.
+"""
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.utils.modeling import get_tensor_stats
+
+
+def topk_mask(xs: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep the top-k entries of the last axis, set the rest to -inf."""
+    if k >= xs.shape[-1]:
+        return xs
+    mintop = jax.lax.top_k(xs, k)[0][..., -1:]
+    return jnp.where(xs < mintop, -jnp.inf, xs)
+
+
+def batched_index_select(x: jnp.ndarray, idxs: jnp.ndarray, axis: int = 1) -> jnp.ndarray:
+    """Gather vectors at `idxs` along `axis`. x: [b, t, d], idxs: [b, n]."""
+    return jnp.take_along_axis(x, idxs[..., None], axis=axis)
+
+
+def ilql_loss(
+    logits: jnp.ndarray,  # [b, t, V] over full sequence
+    qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
+    target_qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
+    vs: jnp.ndarray,  # [b, n_states, 1] (n_states = n_actions + 1)
+    input_ids: jnp.ndarray,  # [b, t]
+    actions_ixs: jnp.ndarray,  # [b, n_actions]
+    dones: jnp.ndarray,  # [b, n_states]
+    rewards: jnp.ndarray,  # [b, n_actions]
+    tau: float,
+    gamma: float,
+    cql_scale: float,
+    awac_scale: float,
+    beta: float = 0.0,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Reference math (modeling_ilql.py:95-166): actions are the tokens at
+    positions actions_ixs of the shifted sequence; Q/V heads were already
+    index-selected by the model."""
+    terminal_mask = dones[:, :-1].astype(jnp.float32)  # [b, n_actions]
+    n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+
+    # token ids actually taken at each action position
+    actions = jnp.take_along_axis(input_ids[:, 1:], actions_ixs, axis=1)  # [b, n_actions]
+
+    Q = [jnp.take_along_axis(q, actions[..., None], axis=-1)[..., 0] for q in qs]
+    targetQs = [
+        jax.lax.stop_gradient(jnp.take_along_axis(q, actions[..., None], axis=-1)[..., 0])
+        for q in target_qs
+    ]
+    targetQ = targetQs[0]
+    for tq in targetQs[1:]:
+        targetQ = jnp.minimum(targetQ, tq)
+    targetQ = jax.lax.stop_gradient(targetQ)
+
+    V = vs[:, :-1, 0]  # values of current states
+    Vnext = vs[:, 1:, 0] * dones[:, 1:].astype(vs.dtype)  # 0 past the end
+    Q_target = rewards + gamma * jax.lax.stop_gradient(Vnext)
+
+    loss_q = sum(
+        (((Qi - Q_target) ** 2) * terminal_mask).sum() / n_nonterminal for Qi in Q
+    )
+
+    # expectile regression of V toward min-target-Q
+    diff = targetQ - V
+    loss_v = (
+        (jnp.where(diff >= 0, tau, 1 - tau) * diff**2) * terminal_mask
+    ).sum() / n_nonterminal
+
+    def cql_loss_fn(q):
+        # cross-entropy of the Q "logits" against the taken actions
+        logprobs = jax.nn.log_softmax(q.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logprobs, actions[..., None], axis=-1)[..., 0]
+        return (nll * terminal_mask).sum() / n_nonterminal
+
+    loss_cql = sum(cql_loss_fn(q) for q in qs)
+
+    # AWAC: CE of the LM logits at action positions, weighted by exp(beta * A)
+    action_logits = batched_index_select(logits, actions_ixs, axis=1)
+    lp = jax.nn.log_softmax(action_logits.astype(jnp.float32), axis=-1)
+    cross_entropy = -jnp.take_along_axis(lp, actions[..., None], axis=-1)[..., 0]
+    awac_weight = jax.lax.stop_gradient(jnp.exp(beta * (targetQ - V)))
+    loss_awac = (cross_entropy * awac_weight * terminal_mask).sum() / n_nonterminal
+
+    loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
+
+    stats = dict(
+        losses=dict(
+            loss=loss, loss_q=loss_q, loss_v=loss_v, loss_cql=loss_cql, loss_awac=loss_awac
+        ),
+        values=get_tensor_stats(V, terminal_mask, n_nonterminal),
+        qvalues={
+            str(ix): get_tensor_stats(Q[ix], terminal_mask, n_nonterminal) for ix in range(len(Q))
+        },
+    )
+    return loss, stats

@@ -1,0 +1,122 @@
+"""PPO math: GAE, clipped surrogate losses, KL coefficient controllers.
+
+Parity: trlx/models/modeling_ppo.py:35-238. The loss math matches the
+reference exactly (clipped value loss, clipped ratio policy loss, k3
+approx-KL diagnostic, clip fractions, per-tensor stats) so reward curves
+are comparable; the GAE reverse loop becomes a `lax.scan`.
+"""
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.utils.modeling import get_tensor_stats, whiten
+
+
+class AdaptiveKLController:
+    """Ziegler et al. adaptive KL controller (reference modeling_ppo.py:35-53).
+    Host-side state updated between rollout phases."""
+
+    def __init__(self, init_kl_coef: float, target: float, horizon: int):
+        self.value = init_kl_coef
+        self.target = target
+        self.horizon = horizon
+
+    def update(self, current: float, n_steps: int):
+        proportional_error = float(np.clip(current / self.target - 1, -0.2, 0.2))
+        mult = 1 + proportional_error * n_steps / self.horizon
+        self.value *= mult
+
+
+class FixedKLController:
+    """Constant KL coefficient (reference modeling_ppo.py:56-67)."""
+
+    def __init__(self, kl_coef: float):
+        self.value = kl_coef
+
+    def update(self, current: float, n_steps: int):
+        pass
+
+
+def get_advantages_and_returns(
+    values: jnp.ndarray,  # [b, response_size]
+    rewards: jnp.ndarray,  # [b, response_size]
+    gamma: float,
+    lam: float,
+    use_whitening: bool = True,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized advantage estimation (reference
+    modeling_ppo.py:136-173). The reference's reversed python loop is a
+    reversed lax.scan:
+
+        delta_t = r_t + gamma * V_{t+1} - V_t
+        A_t     = delta_t + gamma * lam * A_{t+1}
+
+    Returns (advantages, returns) with advantages optionally whitened
+    (global mean/var under pjit)."""
+    next_values = jnp.concatenate([values[:, 1:], jnp.zeros_like(values[:, :1])], axis=1)
+    deltas = rewards + gamma * next_values - values  # [b, t]
+
+    def scan_fn(lastgaelam, delta_t):
+        adv = delta_t + gamma * lam * lastgaelam
+        return adv, adv
+
+    _, adv_rev = jax.lax.scan(scan_fn, jnp.zeros_like(deltas[:, 0]), deltas.T[::-1])
+    advantages = adv_rev[::-1].T
+    returns = advantages + values
+    if use_whitening:
+        advantages = whiten(advantages, mask=mask)
+    return jax.lax.stop_gradient(advantages), returns
+
+
+def ppo_loss(
+    logprobs: jnp.ndarray,  # [b, response]
+    values: jnp.ndarray,
+    old_logprobs: jnp.ndarray,
+    old_values: jnp.ndarray,
+    advantages: jnp.ndarray,
+    returns: jnp.ndarray,
+    mask: jnp.ndarray,
+    cliprange: float,
+    cliprange_value: float,
+    vf_coef: float,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Clipped PPO objective (reference modeling_ppo.py:175-238)."""
+    mask = mask.astype(jnp.float32)
+    values_clipped = jnp.clip(values, old_values - cliprange_value, old_values + cliprange_value)
+    n = jnp.maximum(mask.sum(), 1.0)
+
+    vf_loss1 = (values - returns) ** 2
+    vf_loss2 = (values_clipped - returns) ** 2
+    vf_loss = 0.5 * (jnp.maximum(vf_loss1, vf_loss2) * mask).sum() / n
+    vf_clipfrac = ((vf_loss2 > vf_loss1).astype(jnp.float32) * mask).sum() / n
+
+    log_ratio = (logprobs - old_logprobs) * mask
+    ratio = jnp.exp(log_ratio)
+    # k3 unbiased KL estimator, diagnostic only (http://joschu.net/blog/kl-approx.html)
+    approx_kl = jax.lax.stop_gradient(jnp.mean((ratio - 1) - log_ratio))
+
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss = (jnp.maximum(pg_loss1, pg_loss2) * mask).sum() / n
+    pg_clipfrac = ((pg_loss2 > pg_loss1).astype(jnp.float32) * mask).sum() / n
+
+    loss = pg_loss + vf_coef * vf_loss
+
+    stats = dict(
+        losses=dict(total_loss=loss, policy_loss=pg_loss, value_loss=vf_loss),
+        values=dict(
+            **get_tensor_stats(values, mask, n),
+            values_error=(((values - returns) * mask) ** 2).sum() / n,
+            clipfrac=vf_clipfrac,
+        ),
+        old_values=get_tensor_stats(old_values, mask, n),
+        returns=get_tensor_stats(returns, mask, n),
+        policy=dict(approx_kl=approx_kl, clipfrac=pg_clipfrac),
+        ratio=(ratio * mask).sum() / n,
+        padding_percentage=1.0 - n / mask.size,
+    )
+    return loss, stats

@@ -1,0 +1,202 @@
+"""Jitted autoregressive sampling engine.
+
+This replaces HF `model.generate` (used by the reference at
+accelerate_base_trainer.py:256-282) and the reference's two hand-written
+token loops (ILQL Q-guided generate, modeling_ilql.py:325-412; NeMo
+sampling loop, modeling_nemo_ppo.py:1158-1222) with ONE compiled
+`lax.while_loop`: prefill the KV cache with the (left-padded, static-shape)
+prompt batch, then decode step-by-step entirely on device. Per-step logit
+processing covers temperature / top-k / top-p sampling, a transition
+logit-mask (adjacency constraints, e.g. randomwalks), and the ILQL
+beta*(Q-V) advantage shift — the reference needs a separate generate loop
+per mode; here they are hooks on the same engine.
+
+Early exit: the while_loop condition includes "all sequences finished", so
+short generations stop early (like HF's `StoppingCriteria`) without
+dynamic shapes — outputs are always [b, max_new_tokens], with a validity
+mask. Stop-sequence trimming is string-level host-side post-processing
+(trainer.decode, mirroring accelerate_base_trainer.py:203-254).
+"""
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.transformer import TransformerConfig, init_kv_cache
+from trlx_tpu.ops.ilql import topk_mask
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    """HF-compatible generation knobs (reference default gen_kwargs:
+    default_configs.py:52-57)."""
+
+    max_new_tokens: int = 40
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    do_sample: bool = True
+    eos_token_id: int = 0
+    pad_token_id: int = 0
+    min_new_tokens: int = 0
+    # ILQL advantage shift (reference gen_kwargs beta, default_configs.py:92)
+    beta: float = 1.0
+
+    @classmethod
+    def from_gen_kwargs(cls, gen_kwargs: Dict, eos_token_id: int, pad_token_id: int):
+        kw = dict(gen_kwargs or {})
+        kw.pop("max_length", None)
+        return cls(
+            max_new_tokens=int(kw.get("max_new_tokens", 40)),
+            temperature=float(kw.get("temperature", 1.0)),
+            top_k=int(kw.get("top_k", 0) or 0),
+            top_p=float(kw.get("top_p", 1.0)),
+            do_sample=bool(kw.get("do_sample", True)),
+            min_new_tokens=int(kw.get("min_new_tokens", 0) or 0),
+            beta=float(kw.get("beta", 1.0)),
+            eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id,
+        )
+
+
+def process_logits(
+    logits: jnp.ndarray,  # [b, V] f32
+    cfg: GenerationConfig,
+    step: jnp.ndarray,
+) -> jnp.ndarray:
+    """Temperature / top-k / top-p / min-new-tokens logit processing,
+    matching HF LogitsProcessor order (temperature -> top_k -> top_p)."""
+    logits = logits.astype(jnp.float32)
+    if cfg.min_new_tokens > 0:
+        # forbid EOS before min_new_tokens
+        eos_penalty = jnp.where(step < cfg.min_new_tokens, -jnp.inf, 0.0)
+        logits = logits.at[:, cfg.eos_token_id].add(eos_penalty)
+    if cfg.do_sample and cfg.temperature not in (0.0, 1.0):
+        logits = logits / cfg.temperature
+    if cfg.top_k and cfg.top_k > 0:
+        logits = topk_mask(logits, cfg.top_k)
+    if cfg.do_sample and cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
+        cutoff_mask = cum - probs >= cfg.top_p
+        threshold = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
+def make_generate_fn(
+    model,
+    model_cfg: TransformerConfig,
+    gen_cfg: GenerationConfig,
+    mode: str = "lm",  # "lm" | "ilql"
+    logit_mask: Optional[np.ndarray] = None,  # [V, V] True = forbidden transition
+    two_qs: bool = True,
+) -> Callable:
+    """Build a jittable generate(params, input_ids, attn_mask, rng) ->
+    dict(samples, response_tokens, response_mask). Shapes are static per
+    (batch, prompt_len); jit-cache the returned fn per shape bucket."""
+    max_new = gen_cfg.max_new_tokens
+    forbid = jnp.asarray(logit_mask) if logit_mask is not None else None
+
+    def step_model(params, tokens, cache, token_mask, is_prefill):
+        if mode == "ilql":
+            logits, qs, target_qs, vs, cache = model.apply(
+                {"params": params}, tokens, cache, token_mask, is_prefill,
+                method=type(model).decode_step,
+            )
+            if two_qs:
+                q = jnp.minimum(target_qs[0][:, -1, :], target_qs[1][:, -1, :])
+            else:
+                q = target_qs[0][:, -1, :]
+            adv = q - vs[:, -1, :]  # [b, V]
+            return logits[:, -1].astype(jnp.float32), adv, cache
+        logits, _, cache = model.apply(
+            {"params": params}, tokens, cache, token_mask, is_prefill,
+            method=type(model).decode_step,
+        )
+        return logits[:, -1].astype(jnp.float32), None, cache
+
+    def shift_logits(logits, adv, prev_token):
+        """Mode-specific logit rewrite before sampling."""
+        if forbid is not None:
+            # forbid transitions from the previous token (reference
+            # modeling_ilql.py:378-380)
+            logits = jnp.where(forbid[prev_token], -jnp.inf, logits)
+        if mode == "ilql":
+            logits = jax.nn.log_softmax(logits, axis=-1) + gen_cfg.beta * adv
+        return logits
+
+    def generate(params, input_ids, attn_mask, rng):
+        b, plen = input_ids.shape
+        total = plen + max_new
+        cache = init_kv_cache(model_cfg, b, total)
+        last_logits, last_adv, cache = step_model(params, input_ids, cache, attn_mask, True)
+        if last_adv is None:
+            last_adv = jnp.zeros((b, 1), dtype=jnp.float32)
+
+        out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=input_ids.dtype)
+        out_mask0 = jnp.zeros((b, max_new), dtype=jnp.int32)
+        finished0 = jnp.zeros((b,), dtype=bool)
+        prev_token0 = input_ids[:, -1]
+        state = (0, rng, cache, last_logits, last_adv, prev_token0, out_tokens0, out_mask0, finished0)
+
+        def cond(state):
+            i, _, _, _, _, _, _, _, finished = state
+            return (i < max_new) & ~jnp.all(finished)
+
+        def body(state):
+            i, rng, cache, logits, adv, prev_token, out_tokens, out_mask, finished = state
+            rng, key = jax.random.split(rng)
+            scores = shift_logits(logits, adv, prev_token)
+            scores = process_logits(scores, gen_cfg, i)
+            if gen_cfg.do_sample and gen_cfg.temperature != 0.0:
+                token = jax.random.categorical(key, scores, axis=-1)
+            else:
+                token = jnp.argmax(scores, axis=-1)
+            token = token.astype(input_ids.dtype)
+            token = jnp.where(finished, gen_cfg.pad_token_id, token)
+            valid = (~finished).astype(jnp.int32)
+            finished = finished | (token == gen_cfg.eos_token_id)
+
+            out_tokens = jax.lax.dynamic_update_slice(out_tokens, token[:, None], (0, i))
+            out_mask = jax.lax.dynamic_update_slice(out_mask, valid[:, None], (0, i))
+
+            logits, adv, cache = step_model(params, token[:, None], cache, valid[:, None], False)
+            if adv is None:
+                adv = jnp.zeros((b, 1), dtype=jnp.float32)
+            return (i + 1, rng, cache, logits, adv, token, out_tokens, out_mask, finished)
+
+        (_, _, _, _, _, _, out_tokens, out_mask, _) = jax.lax.while_loop(cond, body, state)
+        samples = jnp.concatenate([input_ids, out_tokens], axis=1)
+        samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
+        return {
+            "samples": samples,
+            "samples_mask": samples_mask,
+            "response_tokens": out_tokens,
+            "response_mask": out_mask,
+        }
+
+    return generate
+
+
+def generate(
+    model,
+    model_cfg: TransformerConfig,
+    params,
+    input_ids,
+    attn_mask,
+    rng,
+    gen_cfg: GenerationConfig,
+    mode: str = "lm",
+    logit_mask=None,
+    two_qs: bool = True,
+):
+    """One-shot convenience wrapper (not cached across shapes)."""
+    fn = make_generate_fn(model, model_cfg, gen_cfg, mode, logit_mask, two_qs)
+    return fn(params, jnp.asarray(input_ids), jnp.asarray(attn_mask), rng)

@@ -1,0 +1,120 @@
+"""Synthetic random-walks task: find short paths to the goal node of a
+random directed graph, with nodes spelled as letters.
+
+Capability parity with the reference's cheap CI-able benchmark
+(examples/randomwalks/randomwalks.py): returns a metric function scoring
+sampled paths by optimality in [0, 1] vs the true shortest path, eval
+prompts (one per start node), sample walks for offline methods, and the
+adjacency-based logit mask. Implementation is our own (numpy BFS instead
+of networkx; per-sample scoring vectorized)."""
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def _shortest_path_lengths(adj: np.ndarray, goal: int, max_length: int) -> np.ndarray:
+    """BFS from every node to `goal` (lengths include both endpoints,
+    capped at max_length)."""
+    n = adj.shape[0]
+    INF = np.inf
+    dist = np.full(n, INF)
+    dist[goal] = 1  # path of one node
+    frontier = [goal]
+    # BFS over reversed edges
+    while frontier:
+        nxt = []
+        for v in frontier:
+            preds = np.nonzero(adj[:, v])[0]
+            for u in preds:
+                if dist[u] == INF:
+                    dist[u] = dist[v] + 1
+                    nxt.append(u)
+        frontier = nxt
+    dist = np.where(np.isinf(dist), max_length, dist)
+    return np.minimum(dist, max_length).astype(int)
+
+
+def adjacency_to_logit_mask(adj: np.ndarray, vocab_size: int) -> np.ndarray:
+    """Token-space forbidden-transition mask for the sampling engine
+    (trlx_tpu/ops/sampling.py: True = forbidden). Node i maps to token id i
+    (CharTokenizer order); transitions from/to the pad/bos/eos specials are
+    left unconstrained so generation can still terminate."""
+    n = adj.shape[0]
+    forbid = np.zeros((vocab_size, vocab_size), dtype=bool)
+    forbid[:n, :n] = ~adj
+    return forbid
+
+
+def generate_random_walks(
+    n_nodes: int = 21,
+    max_length: int = 10,
+    n_walks: int = 1000,
+    p_edge: float = 0.1,
+    seed: int = 1002,
+    gpt2_tokenizer: bool = False,
+):
+    """Build the task. Returns (metric_fn, eval_prompts, sample_walks, adj,
+    alphabet); `adj[u, v]` is True when the edge u->v exists (node space).
+    Use `adjacency_to_logit_mask(adj, vocab_size)` to get the token-space
+    forbidden-transition mask the sampling engine consumes."""
+    rng = np.random.RandomState(seed)
+
+    while True:
+        adj = rng.rand(n_nodes, n_nodes) > (1 - p_edge)
+        np.fill_diagonal(adj, 0)
+        if np.all(adj.sum(1)):
+            break
+
+    goal = 0
+    adj[goal, :] = 0
+    adj[goal, goal] = 1
+
+    alphabet = "".join(chr(ord("a") + i) for i in range(n_nodes))
+    delimiter = "|" if gpt2_tokenizer else ""
+
+    sample_walks: List[str] = []
+    for _ in range(n_walks):
+        node = rng.randint(1, n_nodes)
+        walk = [node]
+        for _ in range(max_length - 1):
+            node = rng.choice(np.nonzero(adj[node])[0])
+            walk.append(node)
+            if node == goal:
+                break
+        sample_walks.append(delimiter.join(alphabet[i] for i in walk))
+
+    shortest = _shortest_path_lengths(adj, goal, max_length)
+
+    def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+        invalid_path_length = 100
+        lengths, optimal = [], []
+        for s in samples:
+            if gpt2_tokenizer:
+                s = s.replace("|", "")
+            nodes = [ord(c) - ord("a") if "a" <= c <= "z" else 1000 for c in s]
+            length = None
+            for i, v in enumerate(nodes):
+                if v >= n_nodes or (i > 0 and not adj[nodes[i - 1], v]):
+                    length = invalid_path_length
+                    break
+                if v == goal:
+                    length = i + 1
+                    break
+            if length is None:
+                length = invalid_path_length
+            lengths.append(float(length))
+            start = nodes[0] if nodes and nodes[0] < n_nodes else 1
+            optimal.append(int(shortest[start]))
+
+        lengths_arr = np.asarray(lengths)
+        bounded = np.where(lengths_arr == invalid_path_length, max_length, lengths_arr)
+        optimal_arr = np.asarray(optimal, dtype=np.float64)
+        denom = np.maximum(max_length - optimal_arr, 1e-9)
+        optimality = (max_length - bounded) / denom
+        return {"lengths": lengths, "optimality": optimality.tolist()}
+
+    eval_prompts = sorted({w[0] for w in sample_walks})
+    eval_prompts = [p + delimiter for p in eval_prompts]
+
+    return metric_fn, eval_prompts, sample_walks, adj, alphabet

@@ -1,0 +1,176 @@
+"""Pipeline/store tests (counterpart of reference tests/test_pipelines.py
+and test_minibatch.py): dialogue tokenization invariants, prompt pipeline
+padding, PPO collation seams, ILQL stores, minibatch iterator."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data import PPORLElement
+from trlx_tpu.pipeline import DataLoader, MiniBatchIterator, default_collate
+from trlx_tpu.pipeline.offline_pipeline import (
+    DialogStore,
+    ILQLRolloutStorage,
+    PromptPipeline,
+    tokenize_dialogue,
+)
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.tokenizers import ByteTokenizer, CharTokenizer
+
+
+@pytest.fixture
+def tok():
+    return ByteTokenizer()
+
+
+def test_tokenize_dialogue_single_string(tok):
+    msgs = tokenize_dialogue("hello", tok, max_length=32)
+    # bos prompt + output with trailing eos
+    assert msgs[0].is_output is False
+    assert msgs[-1].is_output is True
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+    text = tok.decode([t for m in msgs for t in m.tokens])
+    assert "hello" in text
+
+
+def test_tokenize_dialogue_multi_turn(tok):
+    msgs = tokenize_dialogue(("q1", "a1", "q2", "a2"), tok, max_length=64)
+    flags = [m.is_output for m in msgs]
+    assert flags == [False, True, False, True]
+    assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_tokenize_dialogue_truncation(side):
+    tok = ByteTokenizer(truncation_side=side)
+    long_prompt = "x" * 50
+    msgs = tokenize_dialogue((long_prompt, "yy"), tok, max_length=16)
+    total = sum(len(m.tokens) for m in msgs)
+    assert total <= 16
+    if side == "right":
+        # right truncation keeps the prompt start, cuts the output
+        assert msgs[0].tokens[0] == ord("x")
+    else:
+        # left truncation keeps the output end (eos)
+        assert msgs[-1].tokens[-1] == tok.eos_token_id
+
+
+def test_tokenize_dialogue_odd_raises(tok):
+    with pytest.raises(ValueError):
+        tokenize_dialogue(("a", "b", "c"), tok)
+
+
+def test_dialog_store_labels(tok):
+    msgs = tokenize_dialogue(("ab", "cd"), tok, max_length=32)
+    store = DialogStore([msgs], tok)
+    loader = store.create_loader(1)
+    batch = next(iter(loader))
+    labels = batch["labels"][0]
+    ids = batch["input_ids"][0]
+    mask = batch["attention_mask"][0]
+    # prompt tokens -> -100; output tokens -> token ids
+    n_prompt = sum(len(m.tokens) for m in msgs if not m.is_output)
+    n_total = sum(len(m.tokens) for m in msgs)
+    assert (labels[:n_prompt] == -100).all()
+    np.testing.assert_array_equal(labels[n_prompt:n_total], ids[n_prompt:n_total])
+    assert mask[:n_total].all()
+
+
+def test_prompt_pipeline_padding_and_metadata():
+    tok = ByteTokenizer(padding_side="left")
+    prompts = [{"prompt": "abc", "meta": 1}, {"prompt": "defgh", "meta": 2}]
+    pipe = PromptPipeline(prompts, max_prompt_length=4, tokenizer=tok)
+    loader = pipe.create_loader(2)
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (2, 4)
+    # left padding: first row has 1 pad then 3 tokens
+    assert batch["attention_mask"][0].tolist() == [0, 1, 1, 1]
+    # truncation to max_prompt_length (right side default)
+    assert batch["attention_mask"][1].tolist() == [1, 1, 1, 1]
+    assert batch["meta"] == [1, 2]
+
+
+def test_ppo_rollout_storage_collation():
+    store = PPORolloutStorage(pad_token_id=99, padding_side="left")
+    e1 = PPORLElement(
+        query_tensor=np.array([1, 2, 3]),
+        response_tensor=np.array([4, 5]),
+        logprobs=np.array([-0.1, -0.2]),
+        values=np.array([0.5, 0.6]),
+        rewards=np.array([0.0, 1.0]),
+    )
+    e2 = PPORLElement(
+        query_tensor=np.array([7]),
+        response_tensor=np.array([8, 9, 10]),
+        logprobs=np.array([-0.3, -0.4, -0.5]),
+        values=np.array([0.1, 0.2, 0.3]),
+        rewards=np.array([0.0, 0.0, 2.0]),
+    )
+    store.push([e1, e2])
+    batch = next(iter(store.create_loader(2)))
+    # queries left-padded to the store max (3)
+    assert batch.query_tensors[1].tolist() == [99, 99, 7]
+    assert batch.query_tensors[0].tolist() == [1, 2, 3]
+    # responses right-padded to max (3)
+    assert batch.response_tensors[0].tolist() == [4, 5, 99]
+    assert batch.rewards[0].tolist() == [0.0, 1.0, 0.0]
+
+
+def test_ppo_store_export_history(tmp_path):
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([
+        PPORLElement(np.array([1]), np.array([2]), np.array([-0.5]), np.array([0.0]), np.array([1.0]))
+    ])
+    store.export_history(str(tmp_path))
+    import json, os
+
+    files = os.listdir(tmp_path)
+    assert len(files) == 1
+    data = json.loads((tmp_path / files[0]).read_text())
+    assert data[0]["query_tensor"] == [1]
+
+
+def test_ilql_storage_padding():
+    store = ILQLRolloutStorage(
+        [np.array([1, 2, 3]), np.array([4, 5])],
+        [np.ones(3, dtype=int), np.ones(2, dtype=int)],
+        [np.array([0.0, 1.0], dtype=np.float32), np.array([0.5], dtype=np.float32)],
+        [np.array([0, 1, 2]), np.array([0, 1])],
+        [np.array([0, 1]), np.array([0])],
+        [np.array([1, 1, 0]), np.array([1, 0])],
+    )
+    batch = next(iter(store.create_loader(2, shuffle=False, drop_last=False)))
+    assert batch.input_ids.shape == (2, 3)
+    assert batch.rewards.shape == (2, 2)
+    assert batch.dones[1].tolist() == [1, 0, 0]
+
+
+def test_minibatch_iterator_dict_batches():
+    data = [{"x": np.arange(4) + i} for i in range(8)]
+    loader = DataLoader(data, batch_size=4, collate_fn=default_collate)
+    mbs_per_batch = list(MiniBatchIterator(loader, mb_size=2, num_mb=2))
+    assert len(mbs_per_batch) == 2
+    assert len(mbs_per_batch[0]) == 2
+    assert mbs_per_batch[0][0]["x"].shape == (2, 4)
+
+
+def test_minibatch_iterator_ragged():
+    data = [{"x": np.arange(2)} for _ in range(6)]
+    loader = DataLoader(data, batch_size=4, collate_fn=default_collate)
+    batches = list(MiniBatchIterator(loader, mb_size=2, num_mb=2))
+    # second dataloader batch has only 2 items -> 1 full minibatch
+    assert len(batches[1]) == 1
+
+
+def test_char_tokenizer_eos_roundtrip():
+    tok = CharTokenizer("abc")
+    text = "ab" + tok.eos_token
+    ids = tok.encode(text)
+    assert ids[-1] == tok.eos_token_id
+    assert tok.decode(ids, skip_special_tokens=False) == text
+
+
+def test_byte_tokenizer_eos_roundtrip():
+    tok = ByteTokenizer()
+    text = "hi" + tok.eos_token
+    ids = tok.encode(text)
+    assert ids == [ord("h"), ord("i"), tok.eos_token_id]

@@ -239,15 +239,16 @@ class TRLConfig:
         ("method.gamma": 0.99) or nested dicts; raises on unknown keys."""
         update = {}
         for name, value in config.items():
-            if isinstance(value, dict):
+            if "." not in name:
                 update[name] = value
             else:
+                # Unflatten dotted keys — also when the value is a dict
+                # (the reference drops those silently, configs.py:308-311).
                 *layers, var = name.split(".")
-                if layers:
-                    d = update.setdefault(layers[0], {})
-                    for layer in layers[1:]:
-                        d = d.setdefault(layer, {})
-                    d[var] = value
+                d = update.setdefault(layers[0], {})
+                for layer in layers[1:]:
+                    d = d.setdefault(layer, {})
+                d[var] = value
 
         if not isinstance(baseconfig, Dict):
             baseconfig = baseconfig.to_dict()

@@ -104,10 +104,14 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
     split > 0: blocks[split:], ln_f, and the unembedding (tied embedding or
     lm_head) — everything below the split is frozen and shared live, which
     is exactly the reference's hydra invariant (modeling_ppo.py:400-408).
-    split == 0: the whole LM (a standalone frozen reference model)."""
+    split == 0: the whole LM (a standalone frozen reference model).
+
+    Leaves are materialized as NEW buffers (jnp.copy): the reference copy
+    must not alias the live params, which get donated into the jitted train
+    step and would otherwise be deleted under it."""
     lm = params["lm"]
     if split == 0:
-        return jax.tree_util.tree_map(lambda x: x, lm)
+        return jax.tree_util.tree_map(jnp.copy, lm)
     subtree = {}
     for i in range(split, cfg.n_layers):
         subtree[f"block_{i}"] = lm[f"block_{i}"]
@@ -116,7 +120,7 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
         subtree["embed_tokens"] = lm["embed_tokens"]
     else:
         subtree["lm_head"] = lm["lm_head"]
-    return jax.tree_util.tree_map(lambda x: x, subtree)
+    return jax.tree_util.tree_map(jnp.copy, subtree)
 
 
 def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: int) -> Dict:

@@ -125,13 +125,19 @@ class MeshRuntime:
         return self.sharding()
 
     def shard_batch(self, batch):
-        """Place a host batch pytree onto the mesh, batch-dim sharded.
-        Non-array leaves pass through untouched."""
+        """Place a host batch pytree onto the mesh, batch-dim sharded over
+        the DP axes. Leaves whose leading dim doesn't divide the DP ways
+        (e.g. a ragged final eval batch) are replicated instead. Non-array
+        leaves pass through untouched."""
         sharding = self.batch_sharding
+        replicated = self.replicated
+        dp = self.dp_size
 
         def _place(x):
             if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1:
-                return jax.device_put(np.asarray(x), sharding)
+                arr = np.asarray(x)
+                target = sharding if arr.shape[0] % dp == 0 else replicated
+                return jax.device_put(arr, target)
             return x
 
         return jax.tree_util.tree_map(_place, batch)

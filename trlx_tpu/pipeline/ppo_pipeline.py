@@ -1,0 +1,90 @@
+"""PPO rollout storage.
+
+Parity: trlx/pipeline/ppo_pipeline.py — append-only PPORLElement history,
+JSON export for rollout logging, and a loader whose collation left-pads
+queries and right-pads responses/logprobs/values/rewards so the
+query|response seam sits at one fixed column (ppo_collate_fn :14-50).
+Padded widths are store-wide maxima (static shapes for XLA).
+"""
+
+import json
+import os
+import time
+from typing import Iterable, List
+
+import numpy as np
+
+from trlx_tpu.data import PPORLBatch, PPORLElement
+from trlx_tpu.pipeline import BaseRolloutStore, DataLoader
+
+
+class PPORolloutStorage(BaseRolloutStore):
+    def __init__(self, pad_token_id: int, padding_side: str = "left"):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.padding_side = padding_side
+        self.history: List[PPORLElement] = []
+
+    def push(self, exps: Iterable[PPORLElement]):
+        self.history += list(exps)
+
+    def clear_history(self):
+        self.history = []
+
+    def export_history(self, location: str, only_text: bool = True):
+        """Dump rollouts as JSON for offline analysis / algorithm
+        distillation (reference ppo_pipeline.py:71-89)."""
+        assert os.path.exists(location)
+        fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
+
+        def exp_to_dict(exp):
+            return {k: np.asarray(v).tolist() for k, v in exp.__dict__.items()}
+
+        data = [exp_to_dict(exp) for exp in self.history]
+        if only_text:
+            keys = ["query_tensor", "response_tensor"]
+            data = [{k: d[k] for k in keys} for d in data]
+        with open(fpath, "w") as f:
+            f.write(json.dumps(data, indent=2))
+
+    def __getitem__(self, index: int) -> PPORLElement:
+        return self.history[index]
+
+    def __len__(self) -> int:
+        return len(self.history)
+
+    def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> DataLoader:
+        max_q = max(len(e.query_tensor) for e in self.history)
+        max_r = max(len(e.response_tensor) for e in self.history)
+        pad_id = self.pad_token_id
+        left_queries = self.padding_side == "left"
+
+        def collate(elems: List[PPORLElement]) -> PPORLBatch:
+            b = len(elems)
+            queries = np.full((b, max_q), pad_id, dtype=np.int32)
+            responses = np.full((b, max_r), pad_id, dtype=np.int32)
+            logprobs = np.zeros((b, max_r), dtype=np.float32)
+            values = np.zeros((b, max_r), dtype=np.float32)
+            rewards = np.zeros((b, max_r), dtype=np.float32)
+            for i, e in enumerate(elems):
+                q = np.asarray(e.query_tensor)
+                r = np.asarray(e.response_tensor)
+                if left_queries:
+                    queries[i, max_q - len(q):] = q
+                else:
+                    queries[i, : len(q)] = q
+                responses[i, : len(r)] = r
+                logprobs[i, : len(e.logprobs)] = e.logprobs
+                values[i, : len(e.values)] = e.values
+                rewards[i, : len(e.rewards)] = e.rewards
+            return PPORLBatch(
+                query_tensors=queries,
+                response_tensors=responses,
+                logprobs=logprobs,
+                values=values,
+                rewards=rewards,
+            )
+
+        return DataLoader(
+            self.history, batch_size, shuffle=shuffle, collate_fn=collate, seed=seed
+        )

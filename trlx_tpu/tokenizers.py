@@ -42,6 +42,31 @@ class BaseTokenizer:
     def batch_decode(self, batch_ids, skip_special_tokens: bool = True) -> List[str]:
         return [self.decode(ids, skip_special_tokens) for ids in batch_ids]
 
+    def _encode_with_specials(self, text: str, encode_plain) -> List[int]:
+        """Map eos/bos special-token *strings* back to their ids so text
+        containing them (e.g. after decode + eos restoration) round-trips."""
+        ids: List[int] = []
+        specials = [(self.eos_token, self.eos_token_id), (self.bos_token, self.bos_token_id)]
+        i = 0
+        while i < len(text):
+            matched = False
+            for tok_str, tok_id in specials:
+                if tok_str and text.startswith(tok_str, i):
+                    ids.append(tok_id)
+                    i += len(tok_str)
+                    matched = True
+                    break
+            if not matched:
+                j = len(text)
+                for tok_str, _ in specials:
+                    if tok_str:
+                        k = text.find(tok_str, i)
+                        if k != -1:
+                            j = min(j, k)
+                ids.extend(encode_plain(text[i:j]))
+                i = j
+        return ids
+
     def __call__(
         self,
         text: Union[str, List[str]],
@@ -100,7 +125,7 @@ class ByteTokenizer(BaseTokenizer):
         self.name_or_path = "byte"
 
     def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
-        ids = list(text.encode("utf-8"))
+        ids = self._encode_with_specials(text, lambda t: list(t.encode("utf-8")))
         if add_eos:
             ids.append(self.eos_token_id)
         return ids
@@ -144,7 +169,9 @@ class CharTokenizer(BaseTokenizer):
         self.name_or_path = f"char:{alphabet}"
 
     def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
-        ids = [self.char_to_id[c] for c in text if c in self.char_to_id]
+        ids = self._encode_with_specials(
+            text, lambda t: [self.char_to_id[c] for c in t if c in self.char_to_id]
+        )
         if add_eos:
             ids.append(self.eos_token_id)
         return ids

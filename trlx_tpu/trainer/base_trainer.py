@@ -1,0 +1,549 @@
+"""Abstract TPU trainer: one trainer family for every mesh layout.
+
+Parity: trlx/trainer/accelerate_base_trainer.py (AccelerateRLTrainer).
+Where the reference needs two backends (Accelerate for DDP/ZeRO, NeMo for
+TP/PP), this single trainer covers all of DP/FSDP/TP/SP by constructing a
+GSPMD mesh from config.parallel and jit-compiling one train step:
+
+- model params live sharded on the mesh (rule table in
+  trlx_tpu/parallel/sharding.py);
+- frozen params (num_layers_unfrozen) are *partitioned out* of the
+  optimizer: loss_fn takes (train_params, frozen_params) and grads are
+  taken w.r.t. the trainable tree only — backprop below the freeze point
+  is dead code XLA eliminates (the reference instead sets requires_grad
+  False, utils/modeling.py:22-38);
+- gradient accumulation over microbatches is two jitted fns (accumulate /
+  apply) — the functional analogue of accelerate's no_sync context
+  (accelerate_base_trainer.py:502-516).
+"""
+
+import json
+import os
+from abc import abstractmethod
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import traverse_util
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models import resolve_split, trainable_mask
+from trlx_tpu.parallel import MeshRuntime, infer_param_shardings
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.tokenizers import get_tokenizer
+from trlx_tpu.trainer import BaseRLTrainer, register_trainer
+from trlx_tpu.utils import Clock, get_optimizer, get_scheduler, set_seed, significant
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.tracking import get_tracker
+
+logger = logging.get_logger(__name__)
+
+
+def partition_params(params: Dict, mask_tree: Dict) -> Tuple[Dict, Dict]:
+    """Split a param tree into (trainable, frozen) flat dicts by mask."""
+    flat = traverse_util.flatten_dict(params)
+    flat_mask = traverse_util.flatten_dict(mask_tree)
+    train = {k: v for k, v in flat.items() if flat_mask[k]}
+    frozen = {k: v for k, v in flat.items() if not flat_mask[k]}
+    return train, frozen
+
+
+def merge_params(train: Dict, frozen: Dict) -> Dict:
+    """Inverse of partition_params -> nested param tree."""
+    return traverse_util.unflatten_dict({**train, **frozen})
+
+
+@register_trainer
+class TPUTrainer(BaseRLTrainer):
+    def __init__(
+        self,
+        config: TRLConfig,
+        reward_fn=None,
+        metric_fn=None,
+        logit_mask=None,
+        stop_sequences=None,
+        devices=None,
+        **kwargs,
+    ):
+        super().__init__(
+            config,
+            reward_fn=reward_fn,
+            metric_fn=metric_fn,
+            logit_mask=logit_mask,
+            stop_sequences=stop_sequences,
+        )
+        set_seed(config.train.seed)
+        self.rng = jax.random.PRNGKey(config.train.seed)
+        self.tokenizer = get_tokenizer(config.tokenizer)
+        self.runtime = MeshRuntime.from_config(config.parallel, devices=devices)
+        self.max_length = config.train.seq_length
+
+        # Model + params (sharded onto the mesh by the rule table)
+        self.model, self.model_cfg, params = self.get_arch(config)
+        self.split = resolve_split(self.model_cfg, config.model.num_layers_unfrozen)
+        self.param_shardings = infer_param_shardings(self.runtime.mesh, params)
+        params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
+
+        # Trainable/frozen partition + optimizer over the trainable tree only
+        mask_tree = self.make_trainable_mask(params)
+        self.train_params, self.frozen_params = partition_params(params, mask_tree)
+        n_train = sum(int(np.prod(np.shape(x))) for x in self.train_params.values())
+        n_total = n_train + sum(int(np.prod(np.shape(x))) for x in self.frozen_params.values())
+        logger.info(f"Trainable params: {n_train:,} / {n_total:,}")
+
+        base_lr = float(config.optimizer.kwargs.get("lr", 1e-4))
+        self.lr_schedule = get_scheduler(config.scheduler.name, base_lr, config.scheduler.kwargs)
+        self.optimizer = get_optimizer(config.optimizer.name, self.lr_schedule, config.optimizer.kwargs)
+        self.opt_state = self.optimizer.init(self.train_params)
+
+        # Batch/microbatch bookkeeping (reference accelerate_base_trainer.py:77-83)
+        self.mb_size = config.train.minibatch_size or config.train.batch_size
+        assert config.train.batch_size % self.mb_size == 0, "Minibatch size must divide batch size"
+        self.num_mb = config.train.batch_size // self.mb_size
+
+        run_name = config.train.run_name or f"{config.train.trainer}/{config.model.model_path}"
+        self.tracker = get_tracker(
+            config.train.tracker,
+            config.to_dict(),
+            run_name,
+            config.train.logging_dir,
+        )
+
+        self.generate_kwargs = dict(config.method.gen_kwargs or {})
+        self.generate_experience_kwargs = getattr(config.method, "gen_experience_kwargs", None)
+
+        self._train_step_fn = None
+        self._accum_fns = None
+        self._generate_cache: Dict[Any, Callable] = {}
+        self.iter_count = 0
+        self.nth_evaluation = 0
+
+    # ------------------------------------------------------------------
+    # Abstract surface (same contract as the reference's AccelerateRLTrainer)
+    # ------------------------------------------------------------------
+
+    @abstractmethod
+    def get_arch(self, config: TRLConfig):
+        """Returns (flax module, TransformerConfig, initialized params)."""
+
+    @abstractmethod
+    def make_loss_fn(self) -> Callable:
+        """Returns a pure fn(train_params, frozen_params, batch) ->
+        (loss, stats) suitable for jit."""
+
+    @abstractmethod
+    def prepare_learning(self):
+        """Set self.train_dataloader, self.eval_dataloader,
+        self.n_inner_epochs, self.total_steps."""
+
+    @abstractmethod
+    def create_train_dataloader(self):
+        pass
+
+    def make_trainable_mask(self, params) -> Dict:
+        return trainable_mask(params, self.model_cfg, self.config.model.num_layers_unfrozen)
+
+    def post_backward_callback(self):
+        pass
+
+    def post_epoch_callback(self):
+        pass
+
+    # ------------------------------------------------------------------
+    # Params / generation / decode helpers
+    # ------------------------------------------------------------------
+
+    @property
+    def params(self) -> Dict:
+        """Full (merged) param tree."""
+        return merge_params(self.train_params, self.frozen_params)
+
+    def next_rng(self) -> jax.Array:
+        self.rng, key = jax.random.split(self.rng)
+        # per-process fold so multi-host samples differ (reference folds
+        # per-DP-rank RNG, modeling_nemo_ppo.py:384-393)
+        return jax.random.fold_in(key, jax.process_index())
+
+    def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm"):
+        """Jit-cached generate fn per (shape, kwargs) bucket."""
+        from trlx_tpu.ops.sampling import GenerationConfig, make_generate_fn
+
+        # repr-normalize values: gen_kwargs may carry unhashable HF-style
+        # knobs (lists/dicts) from configs written against the reference
+        key = (batch_size, prompt_len, repr(sorted(gen_kwargs.items())), mode)
+        if key not in self._generate_cache:
+            gen_cfg = GenerationConfig.from_gen_kwargs(
+                gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
+            )
+            two_qs = bool(getattr(self.config.method, "two_qs", True))
+            fn = make_generate_fn(
+                self.model, self.model_cfg, gen_cfg, mode=mode,
+                logit_mask=self.logit_mask, two_qs=two_qs,
+            )
+            self._generate_cache[key] = jax.jit(fn)
+        return self._generate_cache[key]
+
+    def generate(self, input_ids, attention_mask, gen_kwargs: Optional[Dict] = None, mode: str = "lm"):
+        """Sample continuations for a (host) prompt batch; returns the
+        sampling dict (device arrays)."""
+        gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
+        input_ids = np.asarray(input_ids)
+        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode)
+        return fn(self.params, jnp.asarray(input_ids), jnp.asarray(attention_mask), self.next_rng())
+
+    def decode(
+        self,
+        prompts,
+        samples,
+        prompt_sizes=None,
+        append_eos_token: bool = False,
+    ) -> Tuple[List[str], List[str], List[str]]:
+        """Token->string decode with stop-sequence trimming and eos
+        restoration (reference accelerate_base_trainer.py:203-254)."""
+        prompts = np.asarray(prompts)
+        samples = np.asarray(samples)
+        if prompt_sizes is None:
+            prompt_sizes = [prompts.shape[1]] * len(prompts)
+
+        str_samples, str_prompts, str_outputs = [], [], []
+        for prompt, sample, prompt_size in zip(prompts, samples, prompt_sizes):
+            output_start_ix = 0 if self.config.model.model_arch_type == "seq2seq" else prompt_size
+            str_prompt = self.tokenizer.decode(prompt[:prompt_size], skip_special_tokens=True)
+            str_output = self.tokenizer.decode(sample[output_start_ix:], skip_special_tokens=True)
+
+            trimmed = False
+            if self.stop_sequences:
+                for stop in self.stop_sequences:
+                    stop_ix = str_output.find(stop)
+                    if stop_ix >= 0:
+                        str_output = str_output[:stop_ix].rstrip()
+                        trimmed = True
+
+            # Restore the trailing eos unless generation ran out of budget
+            if append_eos_token and (
+                trimmed
+                or sample[-1] == self.tokenizer.eos_token_id
+                or sample[-1] == self.tokenizer.pad_token_id
+            ):
+                str_output += self.tokenizer.eos_token
+
+            str_prompts.append(str_prompt)
+            str_outputs.append(str_output)
+            if self.config.model.model_arch_type == "seq2seq":
+                sep = getattr(self.tokenizer, "sep_token", "") or ""
+                str_samples.append(str_prompt + sep + str_output)
+            else:
+                str_samples.append(str_prompt + str_output)
+
+        return str_samples, str_prompts, str_outputs
+
+    # ------------------------------------------------------------------
+    # Train step (jit) with gradient accumulation
+    # ------------------------------------------------------------------
+
+    def _build_steps(self):
+        loss_fn = self.make_loss_fn()
+        optimizer = self.optimizer
+
+        def grad_fn(train_params, frozen_params, batch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                train_params, frozen_params, batch
+            )
+            return loss, stats, grads
+
+        def train_step(train_params, frozen_params, opt_state, batch):
+            _, stats, grads = grad_fn(train_params, frozen_params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, train_params)
+            train_params = optax.apply_updates(train_params, updates)
+            return train_params, opt_state, stats
+
+        def accum_step(train_params, frozen_params, acc_grads, batch):
+            _, stats, grads = grad_fn(train_params, frozen_params, batch)
+            acc_grads = jax.tree_util.tree_map(jnp.add, acc_grads, grads)
+            return acc_grads, stats
+
+        def apply_step(train_params, opt_state, acc_grads):
+            grads = jax.tree_util.tree_map(lambda g: g / self.num_mb, acc_grads)
+            updates, opt_state = optimizer.update(grads, opt_state, train_params)
+            train_params = optax.apply_updates(train_params, updates)
+            return train_params, opt_state
+
+        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 2))
+        self._accum_fns = (
+            jax.jit(accum_step, donate_argnums=(2,)),
+            jax.jit(apply_step, donate_argnums=(0, 1, 2)),
+        )
+
+    def batch_to_device(self, batch):
+        """Place a host batch onto the mesh, batch-dim sharded over DP axes."""
+        return self.runtime.shard_batch(batch)
+
+    def train_minibatch(self, minibatch: List[Any]) -> Dict[str, float]:
+        """One optimizer step over `num_mb` microbatches."""
+        if self._train_step_fn is None:
+            self._build_steps()
+        if len(minibatch) == 1:
+            self.train_params, self.opt_state, stats = self._train_step_fn(
+                self.train_params, self.frozen_params, self.opt_state,
+                self.batch_to_device(minibatch[0]),
+            )
+            return stats
+        accum, apply = self._accum_fns
+        acc = jax.tree_util.tree_map(jnp.zeros_like, self.train_params)
+        stats_list = []
+        for mb in minibatch:
+            acc, stats = accum(self.train_params, self.frozen_params, acc, self.batch_to_device(mb))
+            stats_list.append(stats)
+        self.train_params, self.opt_state = apply(self.train_params, self.opt_state, acc)
+        # average stats across microbatches (reference
+        # accelerate_base_trainer.py:580-583)
+        return jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *stats_list)
+
+    # ------------------------------------------------------------------
+    # Learn / evaluate / checkpoints
+    # ------------------------------------------------------------------
+
+    def learn(self):
+        """Outer loop (reference accelerate_base_trainer.py:518-652)."""
+        logger.info("Starting training")
+        self.prepare_learning()
+        self.iter_count = 0
+        self.nth_evaluation = 0
+
+        if self.config.train.resume_from_checkpoint and os.path.exists(
+            self.config.train.resume_from_checkpoint
+        ):
+            self.load(self.config.train.resume_from_checkpoint)
+
+        results = self.evaluate()
+        self.tracker.log(results, step=self.iter_count)
+
+        best_reward = -float("inf")
+        clock = Clock()
+
+        for _ in range(self.config.train.epochs):
+            for _ in range(self.n_inner_epochs):
+                train_dataloader = self.create_train_dataloader()
+                for minibatch in MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb):
+                    stats = self.train_minibatch(minibatch)
+                    self.iter_count += 1
+
+                    if (
+                        self.iter_count % self.config.train.checkpoint_interval == 0
+                        or self.iter_count >= self.total_steps
+                    ):
+                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+                        directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
+                        self.save(directory)
+                        self.save_pretrained(os.path.join(directory, "hf_model"))
+
+                    stats = {
+                        k: float(np.asarray(v)) if np.ndim(v) == 0 else v
+                        for k, v in _flatten_stats(stats).items()
+                    }
+                    stats["time/step"] = clock.tick(self.config.train.batch_size)
+                    stats["learning_rate"] = float(
+                        np.asarray(self.lr_schedule(self.iter_count))
+                    )
+
+                    if (
+                        self.iter_count % self.config.train.eval_interval == 0
+                        or self.iter_count >= self.total_steps
+                    ):
+                        results = self.evaluate()
+                        stats.update(results)
+
+                        if self.config.train.save_best:
+                            current = stats.get(
+                                "reward/mean", stats.get("metrics/reward", -float("inf"))
+                            )
+                            if jax.process_count() > 1:
+                                # rewards exist only on process 0; broadcast so
+                                # every host takes the same save branch (orbax
+                                # save is a collective — skew would deadlock;
+                                # reference all-reduces do_save the same way,
+                                # accelerate_base_trainer.py:621-628)
+                                from jax.experimental import multihost_utils
+
+                                current = float(
+                                    multihost_utils.broadcast_one_to_all(
+                                        np.float32(current)
+                                    )
+                                )
+                            if current > best_reward:
+                                best_reward = current
+                                directory = os.path.join(
+                                    self.config.train.checkpoint_dir, "best_checkpoint"
+                                )
+                                logger.info(f"Saving best checkpoint into {directory}")
+                                self.save(directory)
+                                self.save_pretrained(os.path.join(directory, "hf_model"))
+
+                    self.tracker.log(stats, step=self.iter_count)
+                    loss_desc = " | ".join(
+                        f"{k.split('/')[-1]}: {significant(v)}"
+                        for k, v in stats.items()
+                        if "loss" in k and np.ndim(v) == 0
+                    )
+                    logger.info(f"[step {self.iter_count}/{self.total_steps}] {loss_desc}")
+
+                    if self.iter_count >= self.total_steps:
+                        return results
+
+                self.post_backward_callback()
+            self.post_epoch_callback()
+        return results
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Generate on eval prompts, score with reward_fn/metric_fn
+        (reference accelerate_base_trainer.py:339-500)."""
+        logger.info("Evaluating model")
+        clock = Clock()
+        all_samples, all_prompts, all_outputs = [], [], []
+        all_metadata = []
+        gen_kwargs = self.generate_kwargs
+        gen_sweep_arg = None
+
+        for batch in self.eval_dataloader:
+            out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
+            samples = np.asarray(out["samples"])
+            prompts = np.asarray(batch["input_ids"])
+            str_samples, str_prompts, str_outputs = self.decode(prompts, samples)
+            all_samples += str_samples
+            all_prompts += str_prompts
+            all_outputs += str_outputs
+            metadata = {
+                k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
+            }
+            all_metadata.append(metadata)
+
+        stats: Dict[str, Any] = {"time/generate": clock.tick()}
+
+        metadata = {}
+        for md in all_metadata:
+            for k, v in md.items():
+                metadata.setdefault(k, []).extend(v)
+
+        if jax.process_index() == 0:
+            rows = list(zip(all_prompts, all_outputs))
+            if self.reward_fn:
+                rewards = self.reward_fn(
+                    samples=all_samples,
+                    prompts=all_prompts,
+                    outputs=all_outputs,
+                    tokenizer=self.tokenizer,
+                    **metadata,
+                )
+                rewards = [
+                    float(np.sum(np.asarray(r))) if np.ndim(r) > 0 else float(r)
+                    for r in rewards
+                ]
+                rows = [r + (reward,) for r, reward in zip(rows, rewards)]
+                stats["reward/mean"] = float(np.mean(rewards))
+            if self.metric_fn:
+                metrics = self.metric_fn(
+                    samples=all_samples,
+                    prompts=all_prompts,
+                    outputs=all_outputs,
+                    **metadata,
+                )
+                for k, v in metrics.items():
+                    if np.ndim(v) > 0 and len(v):
+                        stats[f"metrics/{k}"] = float(np.mean(np.asarray(v, dtype=np.float64)))
+                    else:
+                        stats[f"metrics/{k}"] = float(v)
+            self._print_samples_table(rows)
+
+        self.nth_evaluation += 1
+        return stats
+
+    def _print_samples_table(self, rows, max_rows: int = 8):
+        try:
+            from rich.console import Console
+            from rich.table import Table
+
+            columns = ["prompt", "output"] + (["reward"] if rows and len(rows[0]) > 2 else [])
+            table = Table(*columns, title=f"Evaluation #{self.nth_evaluation}", show_lines=True)
+            for row in rows[:max_rows]:
+                table.add_row(*[str(significant(x)) if isinstance(x, float) else str(x) for x in row])
+            Console().print(table)
+        except ImportError:
+            for row in rows[:max_rows]:
+                logger.info(" | ".join(str(x) for x in row))
+
+    # ------------------------------------------------------------------
+    # Checkpointing (orbax) + HF export
+    # ------------------------------------------------------------------
+
+    def save(self, directory: Optional[str] = None):
+        """Save full trainer state (params, optimizer, step) with orbax
+        (reference: accelerator.save_state, accelerate_base_trainer.py:309-317)."""
+        import orbax.checkpoint as ocp
+
+        directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
+        ckptr = ocp.PyTreeCheckpointer()
+        state = {
+            "train_params": self.train_params,
+            "frozen_params": self.frozen_params,
+            "opt_state": self.opt_state,
+        }
+        ckptr.save(os.path.join(directory, "state"), state, force=True)
+        with open(os.path.join(directory, "trainer_state.json"), "w") as f:
+            json.dump({"iter_count": self.iter_count}, f)
+
+    def load(self, directory: str):
+        import orbax.checkpoint as ocp
+
+        directory = os.path.abspath(directory)
+        ckptr = ocp.PyTreeCheckpointer()
+        target = {
+            "train_params": self.train_params,
+            "frozen_params": self.frozen_params,
+            "opt_state": self.opt_state,
+        }
+        state = ckptr.restore(os.path.join(directory, "state"), item=target)
+        self.train_params = state["train_params"]
+        self.frozen_params = state["frozen_params"]
+        self.opt_state = state["opt_state"]
+        path = os.path.join(directory, "trainer_state.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                self.iter_count = json.load(f)["iter_count"]
+        logger.info(f"Restored checkpoint from {directory} at step {self.iter_count}")
+
+    def save_pretrained(self, directory: Optional[str] = None, **kwargs):
+        """Portable export: HF-layout state dict for GPT2/Llama families
+        plus tokenizer info (reference accelerate_base_trainer.py:284-307)."""
+        if jax.process_index() != 0:
+            return
+        directory = directory or os.path.join(self.config.train.checkpoint_dir, "hf_model")
+        os.makedirs(directory, exist_ok=True)
+        try:
+            import torch
+
+            from trlx_tpu.models.hf_interop import params_to_hf_state_dict
+
+            sd = params_to_hf_state_dict(self.params, self.model_cfg)
+            torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+                       os.path.join(directory, "pytorch_model.bin"))
+        except Exception as e:  # model family without HF layout — save msgpack
+            logger.warning(f"HF export unavailable ({e}); saving flax msgpack instead")
+            from flax import serialization
+
+            with open(os.path.join(directory, "params.msgpack"), "wb") as f:
+                f.write(serialization.to_bytes(self.params))
+        with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
+            json.dump(self.config.to_dict(), f, indent=2, default=str)
+
+
+def _flatten_stats(d: Dict, prefix: str = "") -> Dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten_stats(v, key))
+        else:
+            out[key] = v
+    return out

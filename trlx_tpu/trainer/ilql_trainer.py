@@ -1,0 +1,172 @@
+"""ILQL trainer: offline RL from reward-labeled samples.
+
+Parity: trlx/trainer/accelerate_ilql_trainer.py + the ILQLConfig method
+config (modeling_ilql.py:48-93). Experience ingestion tokenizes dialogues,
+derives state/action index maps, normalizes returns, and puts each return
+on the final action token; training drives ilql_loss with the Q/V heads
+index-selected inside the model forward; target Q-heads Polyak-sync every
+`steps_for_target_q_sync` optimizer steps.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data import ILQLBatch
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.models import build_model, sync_target_q_heads, target_q_mask
+from trlx_tpu.models.transformer import position_ids
+from trlx_tpu.ops.ilql import ilql_loss
+from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params, partition_params
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@dataclass
+@register_method
+class ILQLConfig(MethodConfig):
+    """ILQL hyperparameters (reference modeling_ilql.py:48-93)."""
+
+    tau: float = 0.7
+    gamma: float = 0.99
+    cql_scale: float = 0.1
+    awac_scale: float = 1.0
+    alpha: float = 0.001
+    beta: float = 0.0
+    steps_for_target_q_sync: int = 5
+    two_qs: bool = True
+    gen_kwargs: dict = field(default_factory=dict)
+
+
+def make_experience(samples, rewards, tokenizer=None, max_length=2048, verbose=True):
+    """Tokenize samples and shape rewards into an ILQLRolloutStorage
+    (reference accelerate_ilql_trainer.py:30-100). actions_ixs[i] indexes
+    into the shifted sequence: position p predicts token p+1, so an output
+    token at position q is the action taken at state q-1."""
+    if verbose:
+        logger.info("Collecting rollouts")
+    if tokenizer is not None:
+        samples = [tokenize_dialogue(s, tokenizer, max_length) for s in samples]
+
+    all_input_ids = []
+    all_actions_ixs = []
+    all_states_ixs = []
+    all_dones = []
+    for sample in samples:
+        length = 0
+        all_input_ids.append(np.asarray([t for s in sample for t in s.tokens], dtype=np.int32))
+        actions_ixs = []
+        for dm in sample:
+            if dm.is_output:
+                actions_ixs.append(np.arange(length - 1, length + len(dm.tokens) - 1))
+            length += len(dm.tokens)
+        states_ixs = np.concatenate([*actions_ixs, [length - 1]]).astype(np.int32)
+        all_dones.append(np.asarray([1] * (len(states_ixs) - 1) + [0], dtype=np.int32))
+        all_actions_ixs.append(np.concatenate(actions_ixs).astype(np.int32))
+        all_states_ixs.append(states_ixs)
+
+    # normalize returns and place each on its sample's final action
+    returns = np.asarray(rewards, dtype=np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_sample = [np.zeros(len(x), dtype=np.float32) for x in all_actions_ixs]
+    for rs, ret in zip(rewards_per_sample, returns):
+        rs[-1] = ret
+
+    attention_mask = [np.ones(len(x), dtype=np.int32) for x in all_input_ids]
+
+    return ILQLRolloutStorage(
+        all_input_ids, attention_mask, rewards_per_sample,
+        all_states_ixs, all_actions_ixs, all_dones,
+    )
+
+
+@register_trainer
+class ILQLTrainer(TPUTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        if not isinstance(config.method, ILQLConfig):
+            raise ValueError("config.method must be ILQLConfig")
+        self.ilql: ILQLConfig = config.method
+
+    def get_arch(self, config: TRLConfig):
+        return build_model(
+            config.model,
+            vocab_size=self.tokenizer.vocab_size,
+            rng=jax.random.PRNGKey(config.train.seed),
+            with_ilql_heads=True,
+            two_qs=config.method.two_qs,
+        )
+
+    def make_trainable_mask(self, params):
+        # target-Q heads learn only via Polyak sync, not the optimizer
+        mask = super().make_trainable_mask(params)
+        tq = target_q_mask(params)
+        return jax.tree_util.tree_map(lambda m, t: bool(m) and not bool(t), mask, tq)
+
+    def generate(self, input_ids, attention_mask, gen_kwargs=None, mode="ilql"):
+        # Q-guided sampling: beta * (Q - V) logit shift (reference
+        # modeling_ilql.py:325-412) via the engine's ilql mode.
+        return super().generate(input_ids, attention_mask, gen_kwargs, mode=mode)
+
+    def make_loss_fn(self) -> Callable:
+        model = self.model
+        cfg = self.ilql
+
+        def loss_fn(train_params, frozen_params, batch: ILQLBatch):
+            params = merge_params(train_params, frozen_params)
+            logits, qs, target_qs, vs, _ = model.apply(
+                {"params": params},
+                batch.input_ids,
+                batch.attention_mask,
+                position_ids(batch.attention_mask),
+                states_ixs=batch.states_ixs,
+                actions_ixs=batch.actions_ixs,
+            )
+            return ilql_loss(
+                logits, qs, target_qs, vs,
+                batch.input_ids, batch.actions_ixs, batch.dones, batch.rewards,
+                tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
+                awac_scale=cfg.awac_scale, beta=cfg.beta,
+            )
+
+        return loss_fn
+
+    def post_backward_callback(self):
+        pass
+
+    def train_minibatch(self, minibatch):
+        stats = super().train_minibatch(minibatch)
+        if (self.iter_count + 1) % self.ilql.steps_for_target_q_sync == 0:
+            self._sync_target_q_heads()
+        return stats
+
+    def _sync_target_q_heads(self):
+        """Polyak-sync target heads (reference modeling_ilql.py:216-227).
+        Q heads live in train_params, target heads in frozen_params."""
+        params = self.params
+        params["ilql_heads"] = sync_target_q_heads(params["ilql_heads"], self.ilql.alpha)
+        mask = self.make_trainable_mask(params)
+        self.train_params, self.frozen_params = partition_params(params, mask)
+
+    def make_experience(self, samples, rewards, max_length=2048):
+        self.store = make_experience(samples, rewards, self.tokenizer, max_length)
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(self.config.train.batch_size, shuffle=True, drop_last=False)
+
+    def prepare_learning(self):
+        self.train_dataloader = self.create_train_dataloader()
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
+        self.n_inner_epochs = 1
+        self.total_steps = self.config.train.epochs * len(self.train_dataloader)
+        self.total_steps = min(self.total_steps, self.config.train.total_steps)

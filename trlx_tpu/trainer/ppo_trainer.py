@@ -1,0 +1,376 @@
+"""PPO trainer.
+
+Parity: trlx/trainer/accelerate_ppo_trainer.py (AcceleratePPOTrainer) — the
+same rollout->score->precompute->store->optimize cycle, restructured for
+TPU: generation and logprob/value precompute are two jit-compiled programs
+with static shapes (prompts padded to the pipeline max, responses to
+max_new_tokens), the hydra reference branch runs fused with the policy
+forward (ops in trlx_tpu/models/policy.py), and the user reward_fn stays on
+host between the two.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data import PPORLBatch, PPORLElement
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.models import (
+    build_model,
+    forward_policy_and_ref,
+    position_ids,
+    ref_param_subtree,
+)
+from trlx_tpu.ops.ppo import (
+    AdaptiveKLController,
+    FixedKLController,
+    get_advantages_and_returns,
+    ppo_loss,
+)
+from trlx_tpu.parallel import infer_param_shardings
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
+from trlx_tpu.utils import Clock, infinite_dataloader
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.modeling import RunningMoments, logprobs_of_labels
+
+logger = logging.get_logger(__name__)
+
+
+@dataclass
+@register_method
+class PPOConfig(MethodConfig):
+    """PPO hyperparameters; field set identical to the reference
+    (modeling_ppo.py:73-134) so configs carry over. The loss/GAE math these
+    parameterize lives in trlx_tpu/ops/ppo.py."""
+
+    ppo_epochs: int = 4
+    num_rollouts: int = 128
+    chunk_size: int = 128
+    init_kl_coef: float = 0.001
+    target: Optional[float] = None
+    horizon: int = 10000
+    gamma: float = 1.0
+    lam: float = 0.95
+    cliprange: float = 0.2
+    cliprange_value: float = 0.2
+    vf_coef: float = 1.0
+    scale_reward: Optional[str] = None
+    ref_mean: Optional[float] = None
+    ref_std: Optional[float] = None
+    cliprange_reward: float = 10.0
+    gen_kwargs: dict = field(default_factory=dict)
+    gen_experience_kwargs: Optional[dict] = None
+    num_value_layers_unfrozen: int = 0
+
+
+@register_trainer
+class PPOTrainer(TPUTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+
+        self.store = PPORolloutStorage(
+            self.tokenizer.pad_token_id, self.tokenizer.padding_side
+        )
+
+        # Frozen reference branch (hydra): a copy of the top-of-model params
+        # at init (full copy when everything is trainable) — reference
+        # AutoModelForCausalLMWithHydraValueHead (modeling_ppo.py:385-499).
+        ref = ref_param_subtree(self.params, self.model_cfg, self.split)
+        ref_shardings = infer_param_shardings(self.runtime.mesh, ref)
+        self.ref_params = jax.tree_util.tree_map(jax.device_put, ref, ref_shardings)
+
+        if config.method.target is not None:
+            self.kl_ctl = AdaptiveKLController(
+                config.method.init_kl_coef, config.method.target, config.method.horizon
+            )
+        else:
+            self.kl_ctl = FixedKLController(config.method.init_kl_coef)
+
+        self.running_moments = RunningMoments()
+        self.ref_mean = config.method.ref_mean
+        self.ref_std = config.method.ref_std
+        self.mean_kl = 0.0
+
+        self.log_rollouts = config.train.rollout_logging_dir is not None
+        if self.log_rollouts:
+            self.setup_rollout_logging(config)
+
+        self._score_fn = None
+
+    def get_arch(self, config: TRLConfig):
+        return build_model(
+            config.model,
+            vocab_size=self.tokenizer.vocab_size,
+            rng=jax.random.PRNGKey(config.train.seed),
+        )
+
+    def setup_rollout_logging(self, config):
+        import json as _json
+        import os
+        import uuid
+
+        assert os.path.isdir(config.train.rollout_logging_dir)
+        self.run_id = f"run-{uuid.uuid4()}"
+        self.rollout_logging_dir = os.path.join(config.train.rollout_logging_dir, self.run_id)
+        os.mkdir(self.rollout_logging_dir)
+        with open(os.path.join(self.rollout_logging_dir, "config.json"), "w") as f:
+            f.write(_json.dumps(config.to_dict(), indent=2, default=str))
+
+    # ------------------------------------------------------------------
+    # Loss
+    # ------------------------------------------------------------------
+
+    def make_loss_fn(self) -> Callable:
+        model = self.model
+        method = self.config.method
+        pad_id = self.tokenizer.pad_token_id
+
+        def loss_fn(train_params, frozen_params, batch: PPORLBatch):
+            params = merge_params(train_params, frozen_params)
+            query_tensors = batch.query_tensors
+            response_tensors = batch.response_tensors
+            old_logprobs = batch.logprobs
+            old_values = batch.values
+            old_rewards = batch.rewards
+            response_length = old_rewards.shape[1]
+
+            advantages, returns = get_advantages_and_returns(
+                old_values, old_rewards, method.gamma, method.lam
+            )
+
+            tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
+            attention_mask = (tokens != pad_id).astype(jnp.int32)
+            positions = position_ids(attention_mask)
+            logits, values_pred, _ = model.apply(
+                {"params": params}, tokens, attention_mask, positions
+            )
+            values_pred = values_pred[:, :-1]
+            logprobs = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
+
+            start = query_tensors.shape[1] - 1
+            end = start + response_length
+            logprobs = logprobs[:, start:end]
+            values_pred = values_pred[:, start:end]
+            mask = attention_mask[:, start + 1 : end + 1]
+
+            return ppo_loss(
+                logprobs=logprobs,
+                values=values_pred,
+                old_logprobs=old_logprobs,
+                old_values=old_values,
+                advantages=advantages,
+                returns=returns,
+                mask=mask,
+                cliprange=method.cliprange,
+                cliprange_value=method.cliprange_value,
+                vf_coef=method.vf_coef,
+            )
+
+        return loss_fn
+
+    # ------------------------------------------------------------------
+    # Experience collection
+    # ------------------------------------------------------------------
+
+    def _build_score_fn(self):
+        """Jitted rollout scorer: policy logprobs + values + frozen-ref
+        logprobs in one compiled program (the reference runs 2-3 torch
+        forwards, accelerate_ppo_trainer.py:414-446)."""
+        model = self.model
+        split = self.split
+        pad_id = self.tokenizer.pad_token_id
+
+        def score(train_params, frozen_params, ref_params, all_tokens):
+            params = merge_params(train_params, frozen_params)
+            attention_mask = (all_tokens != pad_id).astype(jnp.int32)
+            positions = position_ids(attention_mask)
+            logits, values, ref_logits = forward_policy_and_ref(
+                model, params, ref_params, all_tokens, attention_mask, split, positions
+            )
+            logprobs = logprobs_of_labels(logits[:, :-1, :], all_tokens[:, 1:])
+            ref_logprobs = logprobs_of_labels(ref_logits[:, :-1, :], all_tokens[:, 1:])
+            # per-token log ratio, masked (reference accelerate_ppo_trainer.py:457)
+            log_ratio = (logprobs - ref_logprobs) * attention_mask[:, :-1]
+            kl = jnp.exp(log_ratio) - 1 - log_ratio
+            mean_kl_per_token = kl.mean()
+            mean_kl = kl.sum(1).mean()
+            return logprobs, values[:, :-1], log_ratio, mean_kl, mean_kl_per_token
+
+        self._score_fn = jax.jit(score)
+
+    def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
+        """Collect rollouts: generate -> (host) decode & reward -> jitted
+        logprob/value/ref precompute -> per-token KL-penalized rewards ->
+        store (reference accelerate_ppo_trainer.py:251-524)."""
+        logger.info("Collecting rollouts")
+        if self._score_fn is None:
+            self._build_score_fn()
+
+        clock = Clock()
+        ppo_rl_elements: List[PPORLElement] = []
+        accumulated_stats: List[Dict] = []
+        method = self.config.method
+        pad_id = self.tokenizer.pad_token_id
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        max_new = int(gen_kwargs.get("max_new_tokens", 40))
+
+        while len(ppo_rl_elements) < num_rollouts:
+            stats: Dict[str, float] = {}
+            batch = next(self.prompt_iterator)
+
+            clock.tick()  # reset timer
+            out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
+            samples = np.asarray(out["samples"])  # materialize (also syncs device)
+            stats["time/rollout_generate"] = clock.tick()
+
+            prompt_tensors = np.asarray(batch["input_ids"])
+            n_samples = len(samples)
+            prompt_sizes = [prompt_tensors.shape[1]] * n_samples
+
+            str_samples, str_prompts, str_outputs = self.decode(
+                prompt_tensors, samples, prompt_sizes, append_eos_token=True
+            )
+
+            metadata = {
+                k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
+            }
+            all_scores = self.reward_fn(
+                samples=str_samples,
+                prompts=str_prompts,
+                outputs=str_outputs,
+                tokenizer=self.tokenizer,
+                **metadata,
+            )
+            stats["time/rollout_score"] = clock.tick()
+
+            # scores: [b, S] right-padded with -inf (S=1 for scalar rewards)
+            score_rows = [np.atleast_1d(np.asarray(s, dtype=np.float32)) for s in all_scores]
+            S = max(len(r) for r in score_rows)
+            scores = np.full((n_samples, S), -np.inf, dtype=np.float32)
+            for i, r in enumerate(score_rows):
+                scores[i, : len(r)] = r
+            scores_mask = scores != -np.inf
+
+            # Re-tokenize the (possibly stop-trimmed) outputs and right-pad
+            # to the static response width.
+            outputs = [
+                self.tokenizer.encode(o, add_special_tokens=False)[:max_new]
+                for o in str_outputs
+            ]
+            sample_outputs = np.full((n_samples, max_new), pad_id, dtype=np.int32)
+            for i, o in enumerate(outputs):
+                sample_outputs[i, : len(o)] = o
+
+            if method.cliprange_reward:
+                scores = np.where(
+                    scores_mask,
+                    np.clip(scores, -method.cliprange_reward, method.cliprange_reward),
+                    scores,
+                )
+
+            # Reward scaling stats (reference accelerate_ppo_trainer.py:364-380)
+            sample_scores = (np.where(scores_mask, scores, 0.0)).sum(axis=1)
+            if self.ref_mean is None:
+                self.ref_mean, self.ref_std = float(sample_scores.mean()), float(sample_scores.std())
+            all_scores_mean, all_scores_std = self.running_moments.update(sample_scores)
+            stats["rollout_scores/mean"] = all_scores_mean
+            stats["rollout_scores/std"] = all_scores_std
+            stats["rollout_scores/running_mean"] = self.running_moments.mean
+            stats["rollout_scores/running_std"] = self.running_moments.std
+            if method.scale_reward == "running":
+                scores = np.where(scores_mask, scores / max(self.running_moments.std, 1e-8), scores)
+            elif method.scale_reward == "ref":
+                scores = np.where(scores_mask, scores / max(self.ref_std, 1e-8), scores)
+
+            # Jitted precompute of logprobs/values/ref KL
+            all_tokens = np.concatenate([prompt_tensors, sample_outputs], axis=1)
+            logprobs, values, log_ratio, mean_kl, mean_kl_per_token = self._score_fn(
+                self.train_params, self.frozen_params, self.ref_params,
+                jnp.asarray(all_tokens),
+            )
+            logprobs = np.asarray(logprobs)
+            values = np.asarray(values)
+            log_ratio = np.asarray(log_ratio)
+            mean_kl = float(np.asarray(mean_kl))
+            mean_kl_per_token = float(np.asarray(mean_kl_per_token))
+
+            # Slice per-sample response windows: logprob[i] is the (log)prob
+            # with which all_tokens[i+1] was sampled.
+            start = prompt_tensors.shape[1] - 1
+            kl_penalty = -self.kl_ctl.value * log_ratio
+
+            for ix in range(n_samples):
+                n_resp = int((sample_outputs[ix] != pad_id).sum())
+                if n_resp == 0:
+                    n_resp = 1  # degenerate empty response: keep one slot
+                end = start + n_resp
+                rewards = kl_penalty[ix, start:end].copy()
+                if scores.shape[1] == 1:
+                    # scalar score lands on the final token (HHH practice)
+                    rewards[-1] += scores[ix, 0]
+                else:
+                    score_len = int(scores_mask[ix].sum())
+                    dense = scores[ix, :score_len]
+                    dense = dense[: len(rewards)]
+                    rewards[: len(dense)] += dense
+
+                ppo_rl_elements.append(
+                    PPORLElement(
+                        query_tensor=prompt_tensors[ix],
+                        response_tensor=sample_outputs[ix, :n_resp],
+                        logprobs=logprobs[ix, start:end],
+                        values=values[ix, start:end],
+                        rewards=rewards,
+                    )
+                )
+
+            stats["time/rollout_time"] = clock.tick()
+            stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
+            stats["policy/kl_per_token"] = float(np.sqrt(max(mean_kl_per_token, 0.0)))
+            accumulated_stats.append(stats)
+            logger.info(f"[rollout {len(ppo_rl_elements)} / {num_rollouts}]")
+
+        stats = {
+            k: sum(xs[k] for xs in accumulated_stats) / len(accumulated_stats)
+            for k in accumulated_stats[-1]
+        }
+        stats["kl_ctl_value"] = self.kl_ctl.value
+        self.mean_kl = stats["policy/sqrt_kl"] ** 2
+        self.tracker.log(stats, step=iter_count)
+        self.push_to_store(ppo_rl_elements)
+
+    # ------------------------------------------------------------------
+    # Loop wiring (reference accelerate_ppo_trainer.py:219-249)
+    # ------------------------------------------------------------------
+
+    def add_prompt_pipeline(self, pipeline):
+        loader = pipeline.create_loader(self.config.method.chunk_size, shuffle=True)
+        self.prompt_iterator = infinite_dataloader(loader)
+
+    def post_epoch_callback(self):
+        if self.log_rollouts:
+            self.store.export_history(location=self.rollout_logging_dir)
+        self.store.clear_history()
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
+
+    def post_backward_callback(self):
+        self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+
+    def prepare_learning(self):
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.method.chunk_size)
+        self.make_experience(self.config.method.num_rollouts)
+        self.train_dataloader = self.create_train_dataloader()
+        self.n_inner_epochs = self.config.method.ppo_epochs
+        self.total_steps = (
+            self.config.train.epochs * self.n_inner_epochs * len(self.train_dataloader)
+        )
+        self.total_steps = min(self.total_steps, self.config.train.total_steps)

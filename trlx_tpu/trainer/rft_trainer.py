@@ -1,0 +1,161 @@
+"""RFT (rejection-sampling fine-tuning) trainer.
+
+Parity: trlx/trainer/accelerate_rft_trainer.py — each growth step samples
+n_generations_per_prompt continuations per prompt, scores them with the
+reward_fn, keeps generations above a rising per-prompt score percentile,
+dedups, and fine-tunes with CE on the survivors.
+"""
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.models import build_model
+from trlx_tpu.models.transformer import position_ids
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
+from trlx_tpu.utils import infinite_dataloader, logging
+
+logger = logging.get_logger(__name__)
+
+
+@dataclass
+@register_method
+class RFTConfig(MethodConfig):
+    """Config for RFT (reference accelerate_rft_trainer.py:18-44)."""
+
+    gen_kwargs: dict = field(default_factory=dict)
+    start_percentile: float = 0.7
+    end_percentile: float = 0.95
+    n_improve_steps: int = 4
+    n_generations_per_prompt: int = 32
+
+
+@register_trainer
+class RFTTrainer(TPUTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        super().__init__(config, **kwargs)
+        self.generations_per_prompt = defaultdict(list)
+        self.epoch_count = 0
+
+    def get_arch(self, config: TRLConfig):
+        return build_model(
+            config.model,
+            vocab_size=self.tokenizer.vocab_size,
+            rng=jax.random.PRNGKey(config.train.seed),
+        )
+
+    def make_trainable_mask(self, params):
+        mask = super().make_trainable_mask(params)
+        if "v_head" in mask:
+            mask["v_head"] = jax.tree_util.tree_map(lambda _: False, mask["v_head"])
+        return mask
+
+    def make_loss_fn(self) -> Callable:
+        model = self.model
+
+        def loss_fn(train_params, frozen_params, batch):
+            # CE over all tokens, prompt included (reference
+            # accelerate_rft_trainer.py:83-88 uses labels=input_ids)
+            params = merge_params(train_params, frozen_params)
+            input_ids = batch["input_ids"]
+            attention_mask = batch["attention_mask"]
+            logits, _, _ = model.apply(
+                {"params": params}, input_ids, attention_mask, position_ids(attention_mask)
+            )
+            shift_logits = logits[:, :-1, :].astype(jnp.float32)
+            labels = input_ids[:, 1:]
+            valid = attention_mask[:, 1:] > 0
+            logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
+            nll = -jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+            n = jnp.maximum(valid.sum(), 1)
+            loss = jnp.where(valid, nll, 0.0).sum() / n
+            return loss, {"loss": loss}
+
+        return loss_fn
+
+    def add_prompt_pipeline(self, pipeline: PromptPipeline):
+        self.prompt_dataloader = pipeline.create_loader(self.config.train.batch_size)
+
+    def make_experience(self):
+        """One growth step (reference accelerate_rft_trainer.py:117-197)."""
+        method = self.config.method
+        if self.epoch_count % method.n_improve_steps == 0:
+            generations = []
+            for batch in self.prompt_dataloader:
+                for _ in range(method.n_generations_per_prompt):
+                    out = self.generate(batch["input_ids"], batch["attention_mask"])
+                    samples = np.asarray(out["samples"])
+                    _, str_prompts, str_outputs = self.decode(
+                        np.asarray(batch["input_ids"]), samples, append_eos_token=True
+                    )
+                    generations.extend(
+                        {"prompt": p, "output": o} for p, o in zip(str_prompts, str_outputs)
+                    )
+
+            all_scores = self.reward_fn(
+                samples=[x["prompt"] + x["output"] for x in generations],
+                prompts=[x["prompt"] for x in generations],
+                outputs=[x["output"] for x in generations],
+            )
+            for g, s in zip(generations, all_scores):
+                self.generations_per_prompt[g["prompt"]].append(
+                    {"output": g["output"], "score": float(np.sum(np.asarray(s)))}
+                )
+
+        scores = [
+            [x["score"] for x in self.generations_per_prompt[p]]
+            for p in self.generations_per_prompt
+        ]
+        percentile_delta = (method.end_percentile - method.start_percentile) / method.n_improve_steps
+        percentile = method.start_percentile + percentile_delta * (
+            self.epoch_count % method.n_improve_steps
+        )
+        thresholds = np.array([np.quantile(np.array(s), percentile) for s in scores])
+        # quantized-reward corner case: exclude min values, keep max values
+        thresholds = np.clip(thresholds, thresholds.min() + 1e-3, thresholds.max() - 1e-3)
+
+        samples_selected = []
+        for prompt, threshold in zip(self.generations_per_prompt, thresholds):
+            for x in self.generations_per_prompt[prompt]:
+                if x["score"] >= threshold:
+                    samples_selected.append((prompt, x["output"]))
+        samples_selected = sorted(set(samples_selected))
+
+        self.tracker.log(
+            {
+                "rft/scores_mean": float(np.mean(np.hstack(scores))) if scores else 0.0,
+                "rft/len_samples_selected": len(samples_selected),
+                "rft/threshold_mean": float(thresholds.mean()) if len(thresholds) else 0.0,
+            },
+            step=self.iter_count,
+        )
+
+        if samples_selected:
+            self.store = PromptPipeline(
+                [p + o for p, o in samples_selected],
+                max_prompt_length=self.config.train.seq_length,
+                tokenizer=self.tokenizer,
+            )
+
+    def post_epoch_callback(self):
+        self.epoch_count += 1
+        self.make_experience()
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+
+    def prepare_learning(self):
+        self.epoch_count = 0
+        self.n_inner_epochs = 1
+        self.total_steps = self.config.train.total_steps
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
+        self.make_experience()
+        self.train_dataloader = self.create_train_dataloader()

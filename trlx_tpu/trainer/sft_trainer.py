@@ -1,0 +1,92 @@
+"""Supervised fine-tuning trainer.
+
+Parity: trlx/trainer/accelerate_sft_trainer.py — CE loss over samples
+(strings -> loss on every token; dialog pairs -> loss on output tokens
+only via DialogStore labels).
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.method_configs import MethodConfig, register_method
+from trlx_tpu.models import build_model
+from trlx_tpu.models.transformer import position_ids
+from trlx_tpu.pipeline.offline_pipeline import DialogStore, PromptPipeline, tokenize_dialogue
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
+
+
+@dataclass
+@register_method
+class SFTConfig(MethodConfig):
+    """Config for SFT training (reference accelerate_sft_trainer.py:16-26)."""
+
+    gen_kwargs: dict = field(default_factory=dict)
+
+
+@register_trainer
+class SFTTrainer(TPUTrainer):
+    def get_arch(self, config: TRLConfig):
+        return build_model(
+            config.model,
+            vocab_size=self.tokenizer.vocab_size,
+            rng=jax.random.PRNGKey(config.train.seed),
+        )
+
+    def make_trainable_mask(self, params):
+        # The (unused) value head stays frozen so weight decay can't drift it.
+        mask = super().make_trainable_mask(params)
+        if "v_head" in mask:
+            mask["v_head"] = jax.tree_util.tree_map(lambda _: False, mask["v_head"])
+        return mask
+
+    def make_loss_fn(self) -> Callable:
+        model = self.model
+        ignore_index = DialogStore.IGNORE_INDEX
+
+        def loss_fn(train_params, frozen_params, batch):
+            params = merge_params(train_params, frozen_params)
+            input_ids = batch["input_ids"]
+            attention_mask = batch["attention_mask"]
+            labels = batch.get("labels", None)
+            if labels is None:
+                # loss over all real tokens (reference
+                # accelerate_sft_trainer.py:63-70 masks labels by attention)
+                labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
+            logits, _, _ = model.apply(
+                {"params": params}, input_ids, attention_mask, position_ids(attention_mask)
+            )
+            shift_logits = logits[:, :-1, :].astype(jnp.float32)
+            shift_labels = labels[:, 1:]
+            valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
+            logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
+            safe_labels = jnp.where(valid, shift_labels, 0)
+            nll = -jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+            n = jnp.maximum(valid.sum(), 1)
+            loss = jnp.where(valid, nll, 0.0).sum() / n
+            return loss, {"loss": loss}
+
+        return loss_fn
+
+    def make_experience(self, samples, seq_length: int):
+        """Build the training store from raw samples
+        (reference accelerate_sft_trainer.py:92-97)."""
+        if isinstance(samples[0], str):
+            self.store = PromptPipeline(samples, seq_length, self.tokenizer)
+        else:
+            dialogs = [tokenize_dialogue(d, self.tokenizer, seq_length) for d in samples]
+            self.store = DialogStore(dialogs, self.tokenizer)
+
+    def create_train_dataloader(self):
+        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+
+    def prepare_learning(self):
+        self.train_dataloader = self.create_train_dataloader()
+        self.eval_dataloader = self.eval_pipeline.create_loader(self.config.train.batch_size)
+        self.n_inner_epochs = 1
+        self.total_steps = self.config.train.epochs * len(self.train_dataloader)
+        self.total_steps = min(self.total_steps, self.config.train.total_steps)

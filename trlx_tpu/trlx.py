@@ -1,0 +1,121 @@
+"""Single `train()` entrypoint dispatching online RL (reward_fn -> PPO/RFT),
+offline RL (samples+rewards -> ILQL), or SFT (samples only).
+
+Parity: trlx/trlx.py:15-143 — same signature and dispatch rules, so user
+scripts written against the reference port over by changing the import.
+"""
+
+import os
+import warnings
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import jax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_sft_config,
+)
+from trlx_tpu.utils import set_seed
+from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+
+def train(  # noqa: C901
+    model_path: Optional[str] = None,
+    reward_fn: Optional[Callable[[List[str], List[str], List[str]], List[float]]] = None,
+    dataset: Optional[Iterable[Tuple[str, float]]] = None,
+    samples: Optional[List[str]] = None,
+    rewards: Optional[List[float]] = None,
+    prompts: Optional[List[str]] = None,
+    eval_prompts: Optional[List[str]] = None,
+    metric_fn: Optional[Callable[[List[str], List[str], List[str]], Dict[str, List[float]]]] = None,
+    config: Optional[TRLConfig] = None,
+    stop_sequences: Optional[List[str]] = [],
+    logit_mask=None,
+):
+    """Run online RL, offline RL, or supervised fine-tuning depending on the
+    provided arguments. `reward_fn` + `prompts` select online training;
+    `samples` (+ optional `rewards`) select offline training.
+
+    See the reference docstring (trlx/trlx.py:42-85) for argument
+    descriptions; semantics are identical. `logit_mask` optionally
+    constrains token transitions during generation (e.g. graph adjacency in
+    the randomwalks benchmark).
+    """
+    if config is None:
+        warnings.warn(
+            "Passing the `config` argument implicitly is deprecated, adapt one "
+            "from `trlx_tpu/data/default_configs.py` instead"
+        )
+        if reward_fn:
+            config = default_ppo_config()
+        elif rewards:
+            config = default_ilql_config()
+        else:
+            config = default_sft_config()
+
+    set_seed(config.train.seed)
+
+    if dataset:
+        warnings.warn("the `dataset` argument is deprecated, split it into `samples` and `rewards`")
+        samples, rewards = dataset
+
+    if model_path:
+        config.model.model_path = model_path
+
+    trainer = get_trainer(config.train.trainer)(
+        config=config,
+        reward_fn=reward_fn,
+        metric_fn=metric_fn,
+        stop_sequences=stop_sequences,
+        logit_mask=logit_mask,
+        **config.train.trainer_kwargs,
+    )
+
+    # Global batch: the mesh's data-parallel ways play the role of the
+    # reference's WORLD_SIZE scaling (trlx/trlx.py:100).
+    batch_size = config.train.batch_size
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get(
+        "max_new_tokens", 40
+    )
+
+    # Online training against a reward function (e.g. PPO, RFT)
+    if reward_fn:
+        prompts = prompts or [trainer.tokenizer.bos_token] * batch_size
+        if eval_prompts is None:
+            eval_prompts = prompts[:batch_size]
+        pipeline = get_pipeline(config.train.pipeline)(
+            prompts,
+            max_prompt_length,
+            trainer.tokenizer,
+            add_special_tokens=config.model.model_arch_type == "seq2seq",
+        )
+        trainer.add_prompt_pipeline(pipeline)
+
+    # Offline training from collected samples (e.g. SFT, ILQL)
+    elif samples:
+        if rewards is not None:
+            if len(samples) != len(rewards):
+                raise ValueError(
+                    f"Number of samples {len(samples)} should match the number of rewards {len(rewards)}"
+                )
+        if eval_prompts is None:
+            eval_prompts = [trainer.tokenizer.bos_token] * batch_size
+        if rewards is not None:
+            trainer.make_experience(samples, rewards, config.train.seq_length)
+        else:
+            trainer.make_experience(samples, config.train.seq_length)
+    else:
+        raise ValueError("Either `samples` or `reward_fn` should be given for training")
+
+    eval_pipeline = get_pipeline(config.train.pipeline)(
+        eval_prompts,
+        max_prompt_length,
+        trainer.tokenizer,
+        add_special_tokens=config.model.model_arch_type == "seq2seq",
+    )
+    trainer.add_eval_pipeline(eval_pipeline)
+
+    trainer.learn()
+    return trainer

@@ -1,0 +1,98 @@
+"""Experiment tracking.
+
+Parity: the reference wires wandb/tensorboard through accelerate
+(accelerate_base_trainer.py:89-136). This environment is offline, so the
+default tracker writes JSONL metrics + console summaries; wandb/tensorboard
+are used when importable and selected via config.train.tracker.
+"""
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class Tracker:
+    """No-op base / console tracker."""
+
+    def __init__(self, config_dict: Dict, run_name: str, logging_dir: Optional[str] = None):
+        self.run_name = run_name
+
+    def log(self, stats: Dict[str, Any], step: int):
+        pass
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(Tracker):
+    """Appends one JSON line of metrics per log call (offline-friendly;
+    plays the role of the reference's wandb run for curve comparison)."""
+
+    def __init__(self, config_dict: Dict, run_name: str, logging_dir: Optional[str] = None):
+        super().__init__(config_dict, run_name, logging_dir)
+        self.dir = logging_dir or "logs"
+        os.makedirs(self.dir, exist_ok=True)
+        safe_name = run_name.replace("/", "_")
+        self.path = os.path.join(self.dir, f"{safe_name}.metrics.jsonl")
+        with open(os.path.join(self.dir, f"{safe_name}.config.json"), "w") as f:
+            json.dump(config_dict, f, indent=2, default=str)
+        self._fh = open(self.path, "a")
+
+    def log(self, stats: Dict[str, Any], step: int):
+        row = {"_step": step, "_time": time.time()}
+        for k, v in stats.items():
+            try:
+                row[k] = float(v)
+            except (TypeError, ValueError):
+                continue
+        self._fh.write(json.dumps(row) + "\n")
+        self._fh.flush()
+
+    def finish(self):
+        self._fh.close()
+
+
+class WandbTracker(Tracker):
+    def __init__(self, config_dict: Dict, run_name: str, logging_dir: Optional[str] = None,
+                 project: str = "trlx_tpu", entity: Optional[str] = None,
+                 group: Optional[str] = None, tags=None):
+        import wandb
+
+        self.run = wandb.init(
+            project=project, name=run_name, entity=entity, group=group,
+            tags=tags, config=config_dict, dir=logging_dir,
+        )
+        self.wandb = wandb
+
+    def log(self, stats, step):
+        self.wandb.log(stats, step=step)
+
+    def finish(self):
+        self.run.finish()
+
+
+def get_tracker(name: Optional[str], config_dict: Dict, run_name: str,
+                logging_dir: Optional[str] = None, **kwargs) -> Tracker:
+    import jax
+
+    if jax.process_index() != 0:
+        return Tracker(config_dict, run_name)
+    if name in (None, "none"):
+        return JSONLTracker(config_dict, run_name, logging_dir)
+    if name == "jsonl":
+        return JSONLTracker(config_dict, run_name, logging_dir)
+    if name == "wandb":
+        try:
+            return WandbTracker(config_dict, run_name, logging_dir, **kwargs)
+        except ImportError:
+            logger.warning("wandb not installed; falling back to JSONL tracker")
+            return JSONLTracker(config_dict, run_name, logging_dir)
+    if name == "tensorboard":
+        logger.warning("tensorboard tracker not available in this build; using JSONL")
+        return JSONLTracker(config_dict, run_name, logging_dir)
+    raise ValueError(f"Unknown tracker: {name}")

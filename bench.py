@@ -1,0 +1,116 @@
+"""Headline benchmark: end-to-end PPO samples/sec/chip, GPT-2-small scale.
+
+Measures one full PPO cycle — experience collection (jitted autoregressive
+generation + host reward + jitted logprob/value/ref precompute) followed by
+`ppo_epochs` optimization passes over the rollout store — and reports
+rollout samples per second per chip. This is the reference's
+AcceleratePPOTrainer hot path (make_experience + learn inner loop,
+SURVEY.md §3.2-3.3) on the default PPO hyperparameters
+(num_rollouts=128, chunk_size=128, ppo_epochs=4, max_new_tokens=40).
+
+The reference publishes no throughput numbers (SURVEY.md §6). The
+`vs_baseline` ratio therefore normalizes against the north-star target in
+BASELINE.json — 3x an estimated 1xA100 Accelerate-PPO rate of ~12
+samples/s for this exact config (128 rollouts x 40 generated tokens plus 4
+PPO epochs in a ~10s iteration is typical for torch gpt2-small PPO on one
+A100) — i.e. vs_baseline >= 1.0 means the >=3x-per-chip goal is met.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ESTIMATED_A100_SAMPLES_PER_SEC = 12.0
+NORTH_STAR_MULTIPLE = 3.0
+
+
+def build_trainer(smoke: bool = False):
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    model = "random:gpt2-tiny" if smoke else "random:gpt2-small"
+    num_rollouts = 16 if smoke else 128
+    max_new = 8 if smoke else 40
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path=model, num_layers_unfrozen=2),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=128, batch_size=32 if not smoke else 8, tracker=None),
+        method=dict(
+            num_rollouts=num_rollouts,
+            chunk_size=num_rollouts,
+            gen_kwargs=dict(max_new_tokens=max_new, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+    def reward_fn(samples, prompts, outputs, **kwargs):
+        # Deterministic host-side reward (letter-frequency proxy): cheap and
+        # offline, exercising the same host<->device choreography as a real
+        # reward model without requiring checkpoint downloads.
+        return [float(out.count("e") - out.count("z")) for out in outputs]
+
+    trainer = PPOTrainer(config, reward_fn=reward_fn)
+
+    rng = np.random.default_rng(0)
+    prompts = ["".join(chr(c) for c in rng.integers(97, 123, size=24)) for _ in range(256)]
+    pipeline = PromptPipeline(prompts, max_prompt_length=24, tokenizer=trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    return trainer, config
+
+
+def run_cycle(trainer, config):
+    """One full PPO iteration: collect rollouts, then optimize over them."""
+    from trlx_tpu.pipeline import MiniBatchIterator
+
+    trainer.store.clear_history()
+    trainer.make_experience(config.method.num_rollouts)
+    stats = None
+    for _ in range(config.method.ppo_epochs):
+        loader = trainer.store.create_loader(config.train.batch_size, shuffle=True)
+        for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+            stats = trainer.train_minibatch(minibatch)
+    # Force a device->host sync: on the axon relay backend block_until_ready
+    # does not block, so timing is only correct after a host copy.
+    return float(np.asarray(stats["losses"]["total_loss"]))
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    t0 = time.time()
+    trainer, config = build_trainer(smoke)
+
+    import jax
+
+    n_chips = max(jax.device_count(), 1)
+
+    run_cycle(trainer, config)  # warmup: compiles generate/score/train steps
+    warm = time.time()
+
+    cycles = 1 if smoke else 2
+    for _ in range(cycles):
+        run_cycle(trainer, config)
+    elapsed = time.time() - warm
+
+    samples = cycles * config.method.num_rollouts
+    sps_chip = samples / elapsed / n_chips
+    baseline = ESTIMATED_A100_SAMPLES_PER_SEC * NORTH_STAR_MULTIPLE
+    print(json.dumps({
+        "metric": "ppo_samples_per_sec_per_chip",
+        "value": round(sps_chip, 3),
+        "unit": "samples/s/chip",
+        "vs_baseline": round(sps_chip / baseline, 3),
+    }))
+    sys.stderr.write(
+        f"[bench] setup+warmup {warm - t0:.1f}s, {cycles} timed cycles in "
+        f"{elapsed:.1f}s on {n_chips} chip(s) ({jax.devices()[0].platform})\n"
+    )
+
+
+if __name__ == "__main__":
+    main()

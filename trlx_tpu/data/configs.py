@@ -256,12 +256,21 @@ class TRLConfig:
         # Validate every leaf path before merging (the reference only checks
         # top-level keys, configs.py:322-327, silently dropping nested typos
         # like "train.batch_sz" — we check recursively).
+        # Open-ended dicts accept arbitrary new keys (a sweep may set e.g.
+        # method.gen_kwargs.temperature even if the base dict lacks it).
+        open_dicts = {
+            "kwargs", "gen_kwargs", "gen_experience_kwargs",
+            "trainer_kwargs", "model_extra_configs", "peft_config",
+        }
+
         def _check_keys(base: Dict, upd: Dict, prefix: str = ""):
             for k, v in upd.items():
                 if k not in base:
                     raise ValueError(
                         f"parameter {prefix}{k} is not present in the config (typo or a wrong config)"
                     )
+                if k in open_dicts:
+                    continue
                 if isinstance(v, dict) and isinstance(base[k], dict):
                     _check_keys(base[k], v, prefix + k + ".")
 

@@ -131,7 +131,10 @@ class DialogStore(BaseRolloutStore):
                 labels[i, :n] = it["labels"]
             return dict(input_ids=ids, attention_mask=mask, labels=labels)
 
-        return DataLoader(self.history, batch_size, shuffle=shuffle, collate_fn=collate)
+        return DataLoader(
+            self.history, batch_size, shuffle=shuffle, collate_fn=collate,
+            seed=kwargs.get("seed", 0),
+        )
 
 
 @register_datapipeline

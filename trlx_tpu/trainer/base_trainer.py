@@ -404,7 +404,6 @@ class TPUTrainer(BaseRLTrainer):
         all_samples, all_prompts, all_outputs = [], [], []
         all_metadata = []
         gen_kwargs = self.generate_kwargs
-        gen_sweep_arg = None
 
         for batch in self.eval_dataloader:
             out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)

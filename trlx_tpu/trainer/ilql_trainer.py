@@ -9,10 +9,9 @@ index-selected inside the model forward; target Q-heads Polyak-sync every
 """
 
 from dataclasses import dataclass, field
-from typing import Callable, List
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.data import ILQLBatch
@@ -141,9 +140,6 @@ class ILQLTrainer(TPUTrainer):
 
         return loss_fn
 
-    def post_backward_callback(self):
-        pass
-
     def train_minibatch(self, minibatch):
         stats = super().train_minibatch(minibatch)
         if (self.iter_count + 1) % self.ilql.steps_for_target_q_sync == 0:
@@ -162,7 +158,10 @@ class ILQLTrainer(TPUTrainer):
         self.store = make_experience(samples, rewards, self.tokenizer, max_length)
 
     def create_train_dataloader(self):
-        return self.store.create_loader(self.config.train.batch_size, shuffle=True, drop_last=False)
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=False,
+            seed=self.config.train.seed + self.iter_count,
+        )
 
     def prepare_learning(self):
         self.train_dataloader = self.create_train_dataloader()

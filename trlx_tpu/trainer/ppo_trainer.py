@@ -10,7 +10,7 @@ host between the two.
 """
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -363,7 +363,12 @@ class PPOTrainer(TPUTrainer):
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
 
     def create_train_dataloader(self):
-        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        # seed moves with iter_count so each inner epoch reshuffles (the
+        # reference's torch DataLoader draws from global RNG each epoch)
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
 
     def prepare_learning(self):
         self.eval_dataloader = self.eval_pipeline.create_loader(self.config.method.chunk_size)

@@ -21,7 +21,7 @@ from trlx_tpu.models.transformer import position_ids
 from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
-from trlx_tpu.utils import infinite_dataloader, logging
+from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
 
@@ -150,7 +150,10 @@ class RFTTrainer(TPUTrainer):
         self.make_experience()
 
     def create_train_dataloader(self):
-        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
 
     def prepare_learning(self):
         self.epoch_count = 0

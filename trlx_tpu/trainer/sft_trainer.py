@@ -82,7 +82,10 @@ class SFTTrainer(TPUTrainer):
             self.store = DialogStore(dialogs, self.tokenizer)
 
     def create_train_dataloader(self):
-        return self.store.create_loader(self.config.train.batch_size, shuffle=True)
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
 
     def prepare_learning(self):
         self.train_dataloader = self.create_train_dataloader()

@@ -9,8 +9,6 @@ import os
 import warnings
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
-import jax
-
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import (
     default_ilql_config,

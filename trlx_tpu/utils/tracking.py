@@ -41,7 +41,9 @@ class JSONLTracker(Tracker):
         self.path = os.path.join(self.dir, f"{safe_name}.metrics.jsonl")
         with open(os.path.join(self.dir, f"{safe_name}.config.json"), "w") as f:
             json.dump(config_dict, f, indent=2, default=str)
-        self._fh = open(self.path, "a")
+        # truncate: one file per run (matches the config.json overwrite);
+        # appending across reruns would interleave restarted _step sequences
+        self._fh = open(self.path, "w")
 
     def log(self, stats: Dict[str, Any], step: int):
         row = {"_step": step, "_time": time.time()}

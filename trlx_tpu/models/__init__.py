@@ -1,10 +1,15 @@
 """Model construction from a ModelConfig.
 
 `model_path` dispatch:
-- "random:<preset>" — from-scratch init with a named preset
-  (trlx_tpu/models/transformer.py PRESETS); offline-friendly.
+- "random:<preset>" — from-scratch init with a named preset (causal presets
+  in trlx_tpu/models/transformer.py PRESETS, seq2seq presets in
+  trlx_tpu/models/seq2seq.py SEQ2SEQ_PRESETS); offline-friendly.
 - anything else — treated as an HF checkpoint directory/name and loaded
   via trlx_tpu/models/hf_interop.py (torch-cpu weight conversion).
+
+`model_arch_type` ("causal" | "seq2seq", reference configs.py:49-55)
+selects the model family; the freezing/hydra utilities dispatch on the
+resolved config type so trainers stay family-agnostic.
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -16,11 +21,23 @@ from trlx_tpu.models.heads import ILQLHeads, MLPHead, sync_target_q_heads  # noq
 from trlx_tpu.models.policy import (  # noqa: F401
     CausalLMWithILQLHeads,
     CausalLMWithValueHead,
+    apply_trainable_mask,
     forward_policy_and_ref,
-    ref_param_subtree,
     resolve_split,
     target_q_mask,
-    trainable_mask,
+)
+from trlx_tpu.models.policy import ref_param_subtree as _causal_ref_param_subtree
+from trlx_tpu.models.policy import trainable_mask as _causal_trainable_mask
+from trlx_tpu.models.seq2seq import (  # noqa: F401
+    SEQ2SEQ_PRESETS,
+    Seq2SeqConfig,
+    Seq2SeqLM,
+    Seq2SeqLMWithILQLHeads,
+    Seq2SeqLMWithValueHead,
+    forward_seq2seq_policy_and_ref,
+    seq2seq_config_from_preset,
+    seq2seq_ref_param_subtree,
+    seq2seq_trainable_mask,
 )
 from trlx_tpu.models.transformer import (  # noqa: F401
     PRESETS,
@@ -32,15 +49,44 @@ from trlx_tpu.models.transformer import (  # noqa: F401
 )
 
 
-def resolve_transformer_config(model_config, vocab_size: int) -> TransformerConfig:
-    """Build a TransformerConfig from a trlx_tpu ModelConfig."""
+def is_seq2seq_config(cfg) -> bool:
+    return bool(getattr(cfg, "is_seq2seq", False))
+
+
+def trainable_mask(params: Dict, cfg, num_layers_unfrozen: int) -> Dict:
+    """Family-dispatching trainable mask (reference freeze_bottom_causal_
+    layers / freeze_bottom_seq2seq_layers, utils/modeling.py:22-60)."""
+    if is_seq2seq_config(cfg):
+        return seq2seq_trainable_mask(params, cfg, num_layers_unfrozen)
+    return _causal_trainable_mask(params, cfg, num_layers_unfrozen)
+
+
+def ref_param_subtree(params: Dict, cfg, split: int) -> Dict:
+    """Family-dispatching frozen-reference subtree extraction."""
+    if is_seq2seq_config(cfg):
+        return seq2seq_ref_param_subtree(params, cfg, split)
+    return _causal_ref_param_subtree(params, cfg, split)
+
+
+def resolve_transformer_config(model_config, vocab_size: int):
+    """Build a TransformerConfig / Seq2SeqConfig from a trlx_tpu ModelConfig."""
     path = model_config.model_path
     extra = dict(model_config.model_extra_configs or {})
     dtype_overrides = {}
     if "dtype" in extra:
         dtype_overrides["dtype"] = jnp.dtype(extra.pop("dtype"))
+    seq2seq = getattr(model_config, "model_arch_type", "causal") == "seq2seq"
     if path.startswith("random:"):
         preset = path[len("random:"):]
+        if preset in SEQ2SEQ_PRESETS and not seq2seq:
+            # model_arch_type is the single source of truth the trainers
+            # dispatch on; a silent promotion here would desync them.
+            raise ValueError(
+                f"Preset '{preset}' is an encoder-decoder model; set "
+                "model_arch_type='seq2seq' in ModelConfig to use it"
+            )
+        if seq2seq:
+            return seq2seq_config_from_preset(preset, vocab_size=vocab_size, **extra, **dtype_overrides)
         return config_from_preset(preset, vocab_size=vocab_size, **extra, **dtype_overrides)
     from trlx_tpu.models import hf_interop
 
@@ -54,18 +100,28 @@ def build_model(
     with_ilql_heads: bool = False,
     two_qs: bool = True,
     seq_len: int = 32,
-) -> Tuple[Any, TransformerConfig, Dict]:
-    """Returns (flax module, transformer config, initialized params)."""
+) -> Tuple[Any, Any, Dict]:
+    """Returns (flax module, model config, initialized params)."""
     cfg = resolve_transformer_config(model_config, vocab_size)
-    if with_ilql_heads:
-        model = CausalLMWithILQLHeads(cfg, two_qs=two_qs)
-    else:
-        model = CausalLMWithValueHead(cfg)
-
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    tokens = jnp.zeros((1, min(seq_len, cfg.max_seq_len)), dtype=jnp.int32)
-    mask = jnp.ones_like(tokens)
-    params = model.init(rng, tokens, mask)["params"]
+
+    if is_seq2seq_config(cfg):
+        if with_ilql_heads:
+            model = Seq2SeqLMWithILQLHeads(cfg, two_qs=two_qs)
+        else:
+            model = Seq2SeqLMWithValueHead(cfg)
+        L = min(seq_len, cfg.max_seq_len)
+        tokens = jnp.zeros((1, L), dtype=jnp.int32)
+        mask = jnp.ones_like(tokens)
+        params = model.init(rng, tokens, mask, tokens, mask)["params"]
+    else:
+        if with_ilql_heads:
+            model = CausalLMWithILQLHeads(cfg, two_qs=two_qs)
+        else:
+            model = CausalLMWithValueHead(cfg)
+        tokens = jnp.zeros((1, min(seq_len, cfg.max_seq_len)), dtype=jnp.int32)
+        mask = jnp.ones_like(tokens)
+        params = model.init(rng, tokens, mask)["params"]
 
     if not model_config.model_path.startswith("random:"):
         from trlx_tpu.models import hf_interop

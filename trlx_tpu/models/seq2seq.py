@@ -1,0 +1,634 @@
+"""TPU-native encoder-decoder (T5-style) model family.
+
+Parity surface (reference -> here):
+- AutoModelForSeq2SeqLMWithValueHead (trlx/models/modeling_ppo.py:1242-1350)
+    -> Seq2SeqLMWithValueHead
+- AutoModelForSeq2SeqLMWithHydraValueHead + decoder-only T5Branch
+  (modeling_ppo.py:1353-1592) -> `seq2seq_ref_param_subtree` +
+  `forward_seq2seq_policy_and_ref`: the frozen reference branch is the top
+  `n_decoder_layers - split` decoder blocks + final norm + unembedding,
+  resumed from the trainable trunk's hidden state in the SAME jit graph.
+- AutoModelForSeq2SeqLMWithILQLHeads (trlx/models/modeling_ilql.py:481-667)
+    -> Seq2SeqLMWithILQLHeads
+- freeze_bottom_seq2seq_layers (trlx/utils/modeling.py:41-60): encoder +
+  bottom decoder blocks frozen -> `seq2seq_trainable_mask`.
+
+Architecture is T5-shaped but built TPU-first: RMS/LayerNorm pre-norm
+blocks, bucketed relative position bias computed once per stack (shared
+across layers, like T5's layer-0 bias), a functional KV cache whose
+cross-attention K/V are projected once at prefill, and bf16 matmuls with
+f32 softmax/logits. Flags `attention_scale` / `logit_scale` cover HF-T5
+numerics (T5 folds the 1/sqrt(hd) into init and scales tied logits by
+d_model**-0.5).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.heads import ILQLHeads, MLPHead
+from trlx_tpu.models.transformer import make_norm, position_ids
+
+
+@dataclass(frozen=True)
+class Seq2SeqConfig:
+    vocab_size: int
+    d_model: int
+    n_encoder_layers: int
+    n_decoder_layers: int
+    n_heads: int
+    d_ff: int
+    max_seq_len: int = 512
+    norm: str = "rmsnorm"
+    activation: str = "relu"
+    glu: bool = False
+    tie_embeddings: bool = True
+    use_bias: bool = False
+    relative_attention: bool = True
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    decoder_start_token_id: int = 0
+    layer_norm_epsilon: float = 1e-6
+    # HF-T5 numerics: no 1/sqrt(hd) score scaling, tied logits scaled by
+    # d_model**-0.5. From-scratch presets keep standard scaling.
+    attention_scale: bool = True
+    logit_scale: Optional[float] = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    @property
+    def is_seq2seq(self) -> bool:
+        return True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_heads
+
+    @property
+    def n_layers(self) -> int:
+        """Hydra-split/freezing axis = decoder depth (the reference's
+        seq2seq branch is decoder-only, modeling_ppo.py:1483-1592)."""
+        return self.n_decoder_layers
+
+
+def relative_position_bucket(
+    relative_position: jnp.ndarray,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jnp.ndarray:
+    """T5-style log-spaced relative position bucketing."""
+    ret = jnp.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    val_large = max_exact + (
+        jnp.log(jnp.maximum(n, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    val_large = jnp.minimum(val_large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, val_large)
+
+
+class RelPosBias(nn.Module):
+    """Bucketed relative attention bias, one embedding per stack shared by
+    all its layers (T5 computes it in layer 0 and shares)."""
+
+    cfg: Seq2SeqConfig
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_positions: jnp.ndarray, k_positions: jnp.ndarray) -> jnp.ndarray:
+        """q_positions: [b, t], k_positions: [b, s] -> bias [b, h, t, s]."""
+        cfg = self.cfg
+        rel = k_positions[:, None, :] - q_positions[:, :, None]  # [b, t, s]
+        buckets = relative_position_bucket(
+            rel, self.bidirectional,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        table = nn.Embed(
+            cfg.relative_attention_num_buckets, cfg.n_heads,
+            dtype=jnp.float32, param_dtype=cfg.param_dtype, name="embedding",
+        )
+        bias = table(buckets)  # [b, t, s, h]
+        return jnp.transpose(bias, (0, 3, 1, 2)).astype(jnp.float32)
+
+
+class S2SAttention(nn.Module):
+    """Self- or cross-attention. For cached decode, self-attention K/V are
+    appended via dynamic_update_slice; cross-attention K/V are projected
+    once (project_kv) at prefill and passed back in as `precomputed_kv`."""
+
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        nh, hd = cfg.n_heads, cfg.head_dim
+        self.q_proj = dense(nh * hd, "q_proj")
+        self.k_proj = dense(nh * hd, "k_proj")
+        self.v_proj = dense(nh * hd, "v_proj")
+        self.o_proj = dense(cfg.d_model, "o_proj")
+
+    def project_kv(self, x_kv: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        b, s, _ = x_kv.shape
+        nh, hd = self.cfg.n_heads, self.cfg.head_dim
+        k = self.k_proj(x_kv).reshape(b, s, nh, hd)
+        v = self.v_proj(x_kv).reshape(b, s, nh, hd)
+        return k, v
+
+    def __call__(
+        self,
+        x_q: jnp.ndarray,  # [b, t, d]
+        x_kv: Optional[jnp.ndarray],  # None => self-attention on x_q
+        attn_bias: jnp.ndarray,  # [b, 1 or h, t, s] additive f32
+        precomputed_kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+        layer_cache: Optional[Dict[str, jnp.ndarray]] = None,
+        cache_index: Optional[jnp.ndarray] = None,
+    ):
+        cfg = self.cfg
+        b, t, d = x_q.shape
+        nh, hd = cfg.n_heads, cfg.head_dim
+        q = self.q_proj(x_q).reshape(b, t, nh, hd)
+        if precomputed_kv is not None:
+            k, v = precomputed_kv
+        else:
+            k, v = self.project_kv(x_kv if x_kv is not None else x_q)
+
+        new_cache = None
+        if layer_cache is not None:
+            ck = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, cache_index, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0)
+            )
+            k, v = ck, cv
+            new_cache = {"k": ck, "v": cv}
+
+        scale = 1.0 / np.sqrt(hd) if cfg.attention_scale else 1.0
+        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32) * scale
+        scores = scores + attn_bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(b, t, nh * hd)
+        return self.o_proj(out), new_cache
+
+
+class S2SMLP(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        dense = lambda feats, name: nn.Dense(
+            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+        )
+        act = {"relu": jax.nn.relu, "silu": jax.nn.silu}.get(cfg.activation, jax.nn.gelu)
+        if cfg.glu:
+            gated = act(dense(cfg.d_ff, "gate_proj")(h)) * dense(cfg.d_ff, "up_proj")(h)
+            return dense(cfg.d_model, "down_proj")(gated)
+        return dense(cfg.d_model, "down_proj")(act(dense(cfg.d_ff, "up_proj")(h)))
+
+
+class EncoderBlock(nn.Module):
+    cfg: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(self, h, attn_bias):
+        cfg = self.cfg
+        attn_out, _ = S2SAttention(cfg, name="attn")(make_norm(cfg, "ln_attn")(h), None, attn_bias)
+        h = h + attn_out
+        h = h + S2SMLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
+        return h
+
+
+class DecoderBlock(nn.Module):
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.ln_attn = make_norm(cfg, "ln_attn")
+        self.attn = S2SAttention(cfg, name="attn")
+        self.ln_cross = make_norm(cfg, "ln_cross")
+        self.cross_attn = S2SAttention(cfg, name="cross_attn")
+        self.ln_mlp = make_norm(cfg, "ln_mlp")
+        self.mlp = S2SMLP(cfg, name="mlp")
+
+    def __call__(
+        self,
+        h,
+        enc_h,  # [b, s, d] or None when cross K/V are precomputed
+        self_bias,
+        cross_bias,
+        layer_cache=None,
+        cache_index=None,
+        cross_kv=None,
+    ):
+        attn_out, new_cache = self.attn(
+            self.ln_attn(h), None, self_bias, layer_cache=layer_cache, cache_index=cache_index
+        )
+        h = h + attn_out
+        cross_out, _ = self.cross_attn(
+            self.ln_cross(h), enc_h, cross_bias, precomputed_kv=cross_kv
+        )
+        h = h + cross_out
+        h = h + self.mlp(self.ln_mlp(h))
+        return h, new_cache
+
+    def project_cross_kv(self, enc_h):
+        return self.cross_attn.project_kv(enc_h)
+
+
+def padding_bias(key_mask: jnp.ndarray) -> jnp.ndarray:
+    """[b, s] key validity -> [b, 1, 1, s] additive bias."""
+    return jnp.where(key_mask[:, None, None, :].astype(bool), 0.0, -1e9).astype(jnp.float32)
+
+
+def causal_padding_bias(mask: jnp.ndarray) -> jnp.ndarray:
+    """[b, t] -> [b, 1, t, t] causal + key-padding bias."""
+    t = mask.shape[-1]
+    causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    allowed = causal[None, None, :, :] & mask[:, None, None, :].astype(bool)
+    return jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+
+
+class Seq2SeqLM(nn.Module):
+    """Encoder-decoder LM with hydra split support on the decoder stack."""
+
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.cfg
+        self.embed_tokens = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="embed_tokens",
+        )
+        self.enc_blocks = [EncoderBlock(cfg, name=f"enc_block_{i}") for i in range(cfg.n_encoder_layers)]
+        self.enc_ln_f = make_norm(cfg, "enc_ln_f")
+        self.dec_blocks = [DecoderBlock(cfg, name=f"dec_block_{i}") for i in range(cfg.n_decoder_layers)]
+        self.dec_ln_f = make_norm(cfg, "dec_ln_f")
+        if cfg.relative_attention:
+            self.enc_rel_bias = RelPosBias(cfg, bidirectional=True, name="enc_rel_bias")
+            self.dec_rel_bias = RelPosBias(cfg, bidirectional=False, name="dec_rel_bias")
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Dense(
+                cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                name="lm_head",
+            )
+
+    # -- encoder ---------------------------------------------------------
+
+    def encode(self, input_ids: jnp.ndarray, attn_mask: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        pos = position_ids(attn_mask)
+        bias = padding_bias(attn_mask)
+        if cfg.relative_attention:
+            bias = bias + self.enc_rel_bias(pos, pos)
+        h = self.embed_tokens(input_ids)
+        for blk in self.enc_blocks:
+            h = blk(h, bias)
+        return self.enc_ln_f(h)
+
+    # -- decoder ---------------------------------------------------------
+
+    def unembed(self, h: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        h_out = self.dec_ln_f(h)
+        if cfg.logit_scale is not None:
+            h_out = h_out * cfg.logit_scale
+        if cfg.tie_embeddings:
+            return self.embed_tokens.attend(h_out), h_out
+        return self.lm_head(h_out), h_out
+
+    def run_dec_blocks(
+        self, h, enc_h, self_bias, cross_bias, start: int, stop: int,
+        cache=None, cache_index=None, cross_kvs=None,
+    ):
+        new_layers = [] if cache is not None else None
+        for i in range(start, stop):
+            layer_cache = cache[i] if cache is not None else None
+            cross_kv = cross_kvs[i] if cross_kvs is not None else None
+            h, new_cache = self.dec_blocks[i](
+                h, enc_h, self_bias, cross_bias,
+                layer_cache=layer_cache, cache_index=cache_index, cross_kv=cross_kv,
+            )
+            if cache is not None:
+                new_layers.append(new_cache)
+        return h, new_layers
+
+    def __call__(
+        self,
+        input_ids: jnp.ndarray,  # [b, s] encoder tokens
+        attn_mask: jnp.ndarray,  # [b, s]
+        decoder_input_ids: jnp.ndarray,  # [b, t]
+        decoder_attn_mask: jnp.ndarray,  # [b, t]
+        split: int = 0,
+    ):
+        """Returns (logits, dec_h_split, dec_h_final, enc_h)."""
+        cfg = self.cfg
+        enc_h = self.encode(input_ids, attn_mask)
+        dec_pos = position_ids(decoder_attn_mask)
+        self_bias = causal_padding_bias(decoder_attn_mask)
+        if cfg.relative_attention:
+            self_bias = self_bias + self.dec_rel_bias(dec_pos, dec_pos)
+        cross_bias = padding_bias(attn_mask)
+        h = self.embed_tokens(decoder_input_ids)
+        h, _ = self.run_dec_blocks(h, enc_h, self_bias, cross_bias, 0, split)
+        h_split = h
+        h, _ = self.run_dec_blocks(h, enc_h, self_bias, cross_bias, split, cfg.n_decoder_layers)
+        logits, h_final = self.unembed(h)
+        return logits, h_split, h_final, enc_h
+
+    def forward_from(
+        self,
+        h_split: jnp.ndarray,
+        enc_h: jnp.ndarray,
+        attn_mask: jnp.ndarray,  # encoder mask [b, s]
+        decoder_attn_mask: jnp.ndarray,  # [b, t]
+        start_layer: int = 0,
+    ) -> jnp.ndarray:
+        """Decoder-only frozen branch from the split point — the T5Branch
+        equivalent (reference modeling_ppo.py:1483-1592)."""
+        cfg = self.cfg
+        dec_pos = position_ids(decoder_attn_mask)
+        self_bias = causal_padding_bias(decoder_attn_mask)
+        if cfg.relative_attention:
+            self_bias = self_bias + self.dec_rel_bias(dec_pos, dec_pos)
+        cross_bias = padding_bias(attn_mask)
+        h, _ = self.run_dec_blocks(
+            h_split, enc_h, self_bias, cross_bias, start_layer, cfg.n_decoder_layers
+        )
+        logits, _ = self.unembed(h)
+        return logits
+
+    # -- cached decode ---------------------------------------------------
+
+    def prepare_cache(self, enc_h: jnp.ndarray, enc_mask: jnp.ndarray, max_len: int):
+        """Build the decode cache: empty self-attn K/V per decoder layer +
+        cross K/V projected once from the encoder output."""
+        cfg = self.cfg
+        b = enc_h.shape[0]
+        layers = []
+        cross = []
+        for blk in self.dec_blocks:
+            layers.append({
+                "k": jnp.zeros((b, max_len, cfg.n_heads, cfg.head_dim), dtype=cfg.dtype),
+                "v": jnp.zeros((b, max_len, cfg.n_heads, cfg.head_dim), dtype=cfg.dtype),
+            })
+            ck, cv = blk.project_cross_kv(enc_h)
+            cross.append({"k": ck, "v": cv})
+        return {
+            "index": jnp.asarray(0, dtype=jnp.int32),
+            "mask": jnp.zeros((b, max_len), dtype=jnp.int32),
+            "pos": jnp.zeros((b,), dtype=jnp.int32),
+            "enc_mask": enc_mask.astype(jnp.int32),
+            "layers": layers,
+            "cross": cross,
+        }
+
+    def decode_step(
+        self,
+        tokens: jnp.ndarray,  # [b, t]
+        cache: Dict[str, Any],
+        token_mask: jnp.ndarray,  # [b, t]
+    ):
+        """One cached decode call (decoder side; encoder already cached)."""
+        cfg = self.cfg
+        b, t = tokens.shape
+        index = cache["index"]
+        S = cache["mask"].shape[-1]
+        new_mask = jax.lax.dynamic_update_slice(
+            cache["mask"], token_mask.astype(cache["mask"].dtype), (0, index)
+        )
+        # decoder rows have no left padding: slot j holds position j
+        q_pos = index + jnp.arange(t)[None, :] + jnp.zeros((b, 1), jnp.int32)
+        k_pos = jnp.arange(S)[None, :] + jnp.zeros((b, 1), jnp.int32)
+        self_bias = padding_bias(new_mask)
+        # causal within the incoming block + forbid future cache slots
+        within = k_pos[:, None, None, :] > q_pos[:, None, :, None]
+        self_bias = self_bias + jnp.where(within, -1e9, 0.0).astype(jnp.float32)
+        if cfg.relative_attention:
+            self_bias = self_bias + self.dec_rel_bias(q_pos, k_pos)
+        cross_bias = padding_bias(cache["enc_mask"])
+
+        cross_kvs = [(c["k"], c["v"]) for c in cache["cross"]]
+        h = self.embed_tokens(tokens)
+        h, new_layers = self.run_dec_blocks(
+            h, None, self_bias, cross_bias, 0, cfg.n_decoder_layers,
+            cache=cache["layers"], cache_index=index, cross_kvs=cross_kvs,
+        )
+        logits, h_final = self.unembed(h)
+        new_cache = {
+            "index": index + t,
+            "mask": new_mask,
+            "pos": cache["pos"] + token_mask.sum(-1).astype(jnp.int32),
+            "enc_mask": cache["enc_mask"],
+            "layers": new_layers,
+            "cross": cache["cross"],
+        }
+        return logits, h_final, new_cache
+
+
+class Seq2SeqLMWithValueHead(nn.Module):
+    """Value head over the decoder's final hidden state (reference
+    AutoModelForSeq2SeqLMWithValueHead, modeling_ppo.py:1242-1350)."""
+
+    cfg: Seq2SeqConfig
+
+    def setup(self):
+        self.lm = Seq2SeqLM(self.cfg, name="lm")
+        self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
+
+    def __call__(self, input_ids, attn_mask, decoder_input_ids, decoder_attn_mask, split: int = 0):
+        logits, h_split, h_final, enc_h = self.lm(
+            input_ids, attn_mask, decoder_input_ids, decoder_attn_mask, split
+        )
+        values = self.v_head(h_final)[..., 0]
+        return logits, values, h_split, enc_h
+
+    def forward_ref_suffix(self, h_split, enc_h, attn_mask, decoder_attn_mask, start_layer: int = 0):
+        return self.lm.forward_from(h_split, enc_h, attn_mask, decoder_attn_mask, start_layer)
+
+    def forward_ref_full(self, input_ids, attn_mask, decoder_input_ids, decoder_attn_mask):
+        logits, _, _, _ = self.lm(input_ids, attn_mask, decoder_input_ids, decoder_attn_mask, 0)
+        return logits
+
+    def encode(self, input_ids, attn_mask):
+        return self.lm.encode(input_ids, attn_mask)
+
+    def prepare_cache(self, enc_h, enc_mask, max_len: int):
+        return self.lm.prepare_cache(enc_h, enc_mask, max_len)
+
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False, with_value: bool = False):
+        logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask)
+        if with_value:
+            return logits, self.v_head(h)[..., 0], new_cache
+        return logits, None, new_cache
+
+
+class Seq2SeqLMWithILQLHeads(nn.Module):
+    """ILQL Q/V heads over decoder hidden states (reference
+    AutoModelForSeq2SeqLMWithILQLHeads, modeling_ilql.py:481-667)."""
+
+    cfg: Seq2SeqConfig
+    two_qs: bool = True
+
+    def setup(self):
+        self.lm = Seq2SeqLM(self.cfg, name="lm")
+        self.ilql_heads = ILQLHeads(
+            self.cfg.vocab_size, self.two_qs, self.cfg.dtype, self.cfg.param_dtype,
+            name="ilql_heads",
+        )
+
+    def __call__(
+        self, input_ids, attn_mask, decoder_input_ids, decoder_attn_mask,
+        states_ixs=None, actions_ixs=None,
+    ):
+        logits, _, h_final, _ = self.lm(
+            input_ids, attn_mask, decoder_input_ids, decoder_attn_mask, 0
+        )
+        qs, target_qs, vs = self.ilql_heads(h_final, states_ixs, actions_ixs)
+        return logits, qs, target_qs, vs, h_final
+
+    def encode(self, input_ids, attn_mask):
+        return self.lm.encode(input_ids, attn_mask)
+
+    def prepare_cache(self, enc_h, enc_mask, max_len: int):
+        return self.lm.prepare_cache(enc_h, enc_mask, max_len)
+
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False):
+        logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask)
+        qs, target_qs, vs = self.ilql_heads(h)
+        return logits, qs, target_qs, vs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Param-tree utilities (seq2seq freezing / hydra branch)
+# ---------------------------------------------------------------------------
+
+
+def seq2seq_ref_param_subtree(params: Dict, cfg: Seq2SeqConfig, split: int) -> Dict:
+    """Copy of the frozen reference branch params: decoder blocks[split:],
+    decoder final norm, decoder relative-bias table, and the unembedding.
+    split == 0 -> the whole LM (standalone frozen reference)."""
+    lm = params["lm"]
+    if split == 0:
+        return jax.tree_util.tree_map(jnp.copy, lm)
+    subtree = {}
+    for i in range(split, cfg.n_decoder_layers):
+        subtree[f"dec_block_{i}"] = lm[f"dec_block_{i}"]
+    subtree["dec_ln_f"] = lm["dec_ln_f"]
+    if cfg.relative_attention:
+        subtree["dec_rel_bias"] = lm["dec_rel_bias"]
+    if cfg.tie_embeddings:
+        subtree["embed_tokens"] = lm["embed_tokens"]
+    else:
+        subtree["lm_head"] = lm["lm_head"]
+    return jax.tree_util.tree_map(jnp.copy, subtree)
+
+
+def seq2seq_trainable_mask(params: Dict, cfg: Seq2SeqConfig, num_layers_unfrozen: int) -> Dict:
+    """True where trainable. Mirrors freeze_bottom_seq2seq_layers
+    (reference utils/modeling.py:41-60): -1 = all LM trainable, 0 = heads
+    only, k>0 = top-k decoder blocks (+ decoder final norm); the encoder
+    and embeddings stay frozen."""
+    split = cfg.n_decoder_layers - num_layers_unfrozen if num_layers_unfrozen > 0 else 0
+
+    def _mask(path_keys, leaf):
+        parts = [getattr(k, "key", str(k)) for k in path_keys]
+        if parts[0] != "lm":
+            return True
+        if num_layers_unfrozen == -1:
+            return True
+        if num_layers_unfrozen == 0:
+            return False
+        name = parts[1]
+        if name.startswith("dec_block_"):
+            return int(name.split("_")[-1]) >= max(split, 0)
+        return name == "dec_ln_f"
+
+    return jax.tree_util.tree_map_with_path(_mask, params)
+
+
+def forward_seq2seq_policy_and_ref(
+    model: Seq2SeqLMWithValueHead,
+    params: Dict,
+    ref_params: Dict,
+    input_ids: jnp.ndarray,
+    attn_mask: jnp.ndarray,
+    decoder_input_ids: jnp.ndarray,
+    decoder_attn_mask: jnp.ndarray,
+    split: int,
+):
+    """Policy logits + values + frozen-reference logits in one jit graph
+    (the reference runs the full T5 twice or keeps a cloned branch module,
+    modeling_ppo.py:1353-1480)."""
+    logits, values, h_split, enc_h = model.apply(
+        {"params": params}, input_ids, attn_mask, decoder_input_ids, decoder_attn_mask, split
+    )
+    if split > 0:
+        ref_logits = model.apply(
+            {"params": {"lm": ref_params}},
+            jax.lax.stop_gradient(h_split),
+            jax.lax.stop_gradient(enc_h),
+            attn_mask,
+            decoder_attn_mask,
+            split,
+            method=Seq2SeqLMWithValueHead.forward_ref_suffix,
+        )
+    else:
+        ref_logits = model.apply(
+            {"params": {"lm": ref_params}},
+            input_ids, attn_mask, decoder_input_ids, decoder_attn_mask,
+            method=Seq2SeqLMWithValueHead.forward_ref_full,
+        )
+    return logits, values, jax.lax.stop_gradient(ref_logits)
+
+
+# ---------------------------------------------------------------------------
+# Presets
+# ---------------------------------------------------------------------------
+
+SEQ2SEQ_PRESETS: Dict[str, Dict[str, Any]] = {
+    "t5-tiny": dict(
+        d_model=64, n_encoder_layers=2, n_decoder_layers=2, n_heads=4, d_ff=256,
+        max_seq_len=256,
+    ),
+    "t5-small": dict(
+        d_model=512, n_encoder_layers=6, n_decoder_layers=6, n_heads=8, d_ff=2048,
+        max_seq_len=512,
+    ),
+    "t5-base": dict(
+        d_model=768, n_encoder_layers=12, n_decoder_layers=12, n_heads=12, d_ff=3072,
+        max_seq_len=512,
+    ),
+    "flan-t5-small": dict(
+        d_model=512, n_encoder_layers=8, n_decoder_layers=8, n_heads=6, d_ff=1024,
+        max_seq_len=512, activation="gelu", glu=True, tie_embeddings=False,
+    ),
+}
+
+
+def seq2seq_config_from_preset(name: str, vocab_size: int, **overrides) -> Seq2SeqConfig:
+    if name not in SEQ2SEQ_PRESETS:
+        raise ValueError(f"Unknown seq2seq preset '{name}'. Available: {sorted(SEQ2SEQ_PRESETS)}")
+    kwargs = dict(SEQ2SEQ_PRESETS[name])
+    kwargs.update(overrides)
+    return Seq2SeqConfig(vocab_size=vocab_size, **kwargs)

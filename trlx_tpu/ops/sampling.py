@@ -100,9 +100,15 @@ def make_generate_fn(
 ) -> Callable:
     """Build a jittable generate(params, input_ids, attn_mask, rng) ->
     dict(samples, response_tokens, response_mask). Shapes are static per
-    (batch, prompt_len); jit-cache the returned fn per shape bucket."""
+    (batch, prompt_len); jit-cache the returned fn per shape bucket.
+
+    Covers both architectures: causal (prefill the prompt into the KV
+    cache, continue) and seq2seq (encode the prompt once, decode from
+    `decoder_start_token_id` with cross-attention — reference T5 generate
+    path via HF, plus ILQL seq2seq generation modeling_ilql.py:481-667)."""
     max_new = gen_cfg.max_new_tokens
     forbid = jnp.asarray(logit_mask) if logit_mask is not None else None
+    is_seq2seq = bool(getattr(model_cfg, "is_seq2seq", False))
 
     def step_model(params, tokens, cache, token_mask, is_prefill):
         if mode == "ilql":
@@ -132,18 +138,12 @@ def make_generate_fn(
             logits = jax.nn.log_softmax(logits, axis=-1) + gen_cfg.beta * adv
         return logits
 
-    def generate(params, input_ids, attn_mask, rng):
-        b, plen = input_ids.shape
-        total = plen + max_new
-        cache = init_kv_cache(model_cfg, b, total)
-        last_logits, last_adv, cache = step_model(params, input_ids, cache, attn_mask, True)
+    def decode_loop(rng, cache, last_logits, last_adv, prev_token0, params, b, token_dtype):
         if last_adv is None:
             last_adv = jnp.zeros((b, 1), dtype=jnp.float32)
-
-        out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=input_ids.dtype)
+        out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=token_dtype)
         out_mask0 = jnp.zeros((b, max_new), dtype=jnp.int32)
         finished0 = jnp.zeros((b,), dtype=bool)
-        prev_token0 = input_ids[:, -1]
         state = (0, rng, cache, last_logits, last_adv, prev_token0, out_tokens0, out_mask0, finished0)
 
         def cond(state):
@@ -159,7 +159,7 @@ def make_generate_fn(
                 token = jax.random.categorical(key, scores, axis=-1)
             else:
                 token = jnp.argmax(scores, axis=-1)
-            token = token.astype(input_ids.dtype)
+            token = token.astype(token_dtype)
             token = jnp.where(finished, gen_cfg.pad_token_id, token)
             valid = (~finished).astype(jnp.int32)
             finished = finished | (token == gen_cfg.eos_token_id)
@@ -173,6 +173,16 @@ def make_generate_fn(
             return (i + 1, rng, cache, logits, adv, token, out_tokens, out_mask, finished)
 
         (_, _, _, _, _, _, out_tokens, out_mask, _) = jax.lax.while_loop(cond, body, state)
+        return out_tokens, out_mask
+
+    def generate(params, input_ids, attn_mask, rng):
+        b, plen = input_ids.shape
+        total = plen + max_new
+        cache = init_kv_cache(model_cfg, b, total)
+        last_logits, last_adv, cache = step_model(params, input_ids, cache, attn_mask, True)
+        out_tokens, out_mask = decode_loop(
+            rng, cache, last_logits, last_adv, input_ids[:, -1], params, b, input_ids.dtype
+        )
         samples = jnp.concatenate([input_ids, out_tokens], axis=1)
         samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
         return {
@@ -182,7 +192,36 @@ def make_generate_fn(
             "response_mask": out_mask,
         }
 
-    return generate
+    def generate_seq2seq(params, input_ids, attn_mask, rng):
+        """Encoder runs once; the decoder starts from decoder_start_token
+        and decodes under the same loop. Samples are decoder-side only
+        (start token included), matching HF seq2seq generate output that
+        the reference stores as response tensors."""
+        b, _ = input_ids.shape
+        start_id = int(getattr(model_cfg, "decoder_start_token_id", gen_cfg.pad_token_id))
+        enc_h = model.apply(
+            {"params": params}, input_ids, attn_mask, method=type(model).encode
+        )
+        cache = model.apply(
+            {"params": params}, enc_h, attn_mask, 1 + max_new,
+            method=type(model).prepare_cache,
+        )
+        start = jnp.full((b, 1), start_id, dtype=input_ids.dtype)
+        ones = jnp.ones((b, 1), dtype=jnp.int32)
+        last_logits, last_adv, cache = step_model(params, start, cache, ones, True)
+        out_tokens, out_mask = decode_loop(
+            rng, cache, last_logits, last_adv, start[:, 0], params, b, input_ids.dtype
+        )
+        samples = jnp.concatenate([start, out_tokens], axis=1)
+        samples_mask = jnp.concatenate([ones, out_mask], axis=1)
+        return {
+            "samples": samples,
+            "samples_mask": samples_mask,
+            "response_tokens": samples,
+            "response_mask": samples_mask,
+        }
+
+    return generate_seq2seq if is_seq2seq else generate
 
 
 def generate(

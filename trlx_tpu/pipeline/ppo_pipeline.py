@@ -56,6 +56,10 @@ class PPORolloutStorage(BaseRolloutStore):
     def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> DataLoader:
         max_q = max(len(e.query_tensor) for e in self.history)
         max_r = max(len(e.response_tensor) for e in self.history)
+        # seq2seq responses carry a leading decoder_start token, so the
+        # per-token stats are one shorter than the response; pad each field
+        # to its own store-wide max.
+        max_p = max(len(e.logprobs) for e in self.history)
         pad_id = self.pad_token_id
         left_queries = self.padding_side == "left"
 
@@ -63,9 +67,9 @@ class PPORolloutStorage(BaseRolloutStore):
             b = len(elems)
             queries = np.full((b, max_q), pad_id, dtype=np.int32)
             responses = np.full((b, max_r), pad_id, dtype=np.int32)
-            logprobs = np.zeros((b, max_r), dtype=np.float32)
-            values = np.zeros((b, max_r), dtype=np.float32)
-            rewards = np.zeros((b, max_r), dtype=np.float32)
+            logprobs = np.zeros((b, max_p), dtype=np.float32)
+            values = np.zeros((b, max_p), dtype=np.float32)
+            rewards = np.zeros((b, max_p), dtype=np.float32)
             for i, e in enumerate(elems):
                 q = np.asarray(e.query_tensor)
                 r = np.asarray(e.response_tensor)

@@ -14,13 +14,19 @@ from typing import Callable
 import jax
 import numpy as np
 
+import jax.numpy as jnp
+
 from trlx_tpu.data import ILQLBatch
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.method_configs import MethodConfig, register_method
 from trlx_tpu.models import build_model, sync_target_q_heads, target_q_mask
 from trlx_tpu.models.transformer import position_ids
 from trlx_tpu.ops.ilql import ilql_loss
-from trlx_tpu.pipeline.offline_pipeline import ILQLRolloutStorage, tokenize_dialogue
+from trlx_tpu.pipeline.offline_pipeline import (
+    ILQLRolloutStorage,
+    ILQLSeq2SeqRolloutStorage,
+    tokenize_dialogue,
+)
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params, partition_params
 from trlx_tpu.utils import logging
@@ -42,6 +48,20 @@ class ILQLConfig(MethodConfig):
     steps_for_target_q_sync: int = 5
     two_qs: bool = True
     gen_kwargs: dict = field(default_factory=dict)
+
+
+def _normalized_returns_per_sample(rewards, all_actions_ixs):
+    """Mean/std-normalize scalar returns and place each on its sample's
+    final action (reference accelerate_ilql_trainer.py:74-84)."""
+    returns = np.asarray(rewards, dtype=np.float64)
+    returns = returns - returns.mean()
+    std = returns.std()
+    if not np.isnan(std) and std > 0:
+        returns = returns / (std + np.finfo(returns.dtype).eps)
+    rewards_per_sample = [np.zeros(len(x), dtype=np.float32) for x in all_actions_ixs]
+    for rs, ret in zip(rewards_per_sample, returns):
+        rs[-1] = ret
+    return rewards_per_sample
 
 
 def make_experience(samples, rewards, tokenizer=None, max_length=2048, verbose=True):
@@ -71,21 +91,54 @@ def make_experience(samples, rewards, tokenizer=None, max_length=2048, verbose=T
         all_actions_ixs.append(np.concatenate(actions_ixs).astype(np.int32))
         all_states_ixs.append(states_ixs)
 
-    # normalize returns and place each on its sample's final action
-    returns = np.asarray(rewards, dtype=np.float64)
-    returns = returns - returns.mean()
-    std = returns.std()
-    if not np.isnan(std) and std > 0:
-        returns = returns / (std + np.finfo(returns.dtype).eps)
-    rewards_per_sample = [np.zeros(len(x), dtype=np.float32) for x in all_actions_ixs]
-    for rs, ret in zip(rewards_per_sample, returns):
-        rs[-1] = ret
-
+    rewards_per_sample = _normalized_returns_per_sample(rewards, all_actions_ixs)
     attention_mask = [np.ones(len(x), dtype=np.int32) for x in all_input_ids]
 
     return ILQLRolloutStorage(
         all_input_ids, attention_mask, rewards_per_sample,
         all_states_ixs, all_actions_ixs, all_dones,
+    )
+
+
+def make_experience_seq2seq(
+    samples, rewards, tokenizer, max_length=2048,
+    decoder_start_token_id=0, verbose=True,
+):
+    """Seq2seq offline ingestion: each sample is a (prompt, output) pair;
+    the prompt feeds the encoder, the output becomes decoder actions
+    (reference accelerate_ilql_trainer.py:179-244)."""
+    if verbose:
+        logger.info("Collecting rollouts")
+
+    all_input_ids = []
+    all_attention_mask = []
+    all_decoder_input_ids = []
+    all_actions_ixs = []
+    all_states_ixs = []
+    all_dones = []
+    for prompt, output in samples:
+        input_ids = np.asarray(tokenizer.encode(prompt)[:max_length], dtype=np.int32)
+        # truncate BEFORE ensuring eos so long outputs keep their terminal
+        # eos (decoder budget is max_length incl. the start token)
+        out = list(tokenizer.encode(output, add_special_tokens=False))[: max_length - 2]
+        if not out or out[-1] != tokenizer.eos_token_id:
+            out.append(tokenizer.eos_token_id)
+        all_input_ids.append(input_ids)
+        all_attention_mask.append(np.ones_like(input_ids))
+        all_decoder_input_ids.append(
+            np.asarray([decoder_start_token_id] + out, dtype=np.int32)
+        )
+        actions_ixs = np.arange(len(out), dtype=np.int32)  # position p predicts token p+1
+        states_ixs = np.concatenate([actions_ixs, [len(out)]]).astype(np.int32)
+        all_actions_ixs.append(actions_ixs)
+        all_states_ixs.append(states_ixs)
+        all_dones.append(np.asarray([1] * (len(states_ixs) - 1) + [0], dtype=np.int32))
+
+    rewards_per_sample = _normalized_returns_per_sample(rewards, all_actions_ixs)
+
+    return ILQLSeq2SeqRolloutStorage(
+        all_input_ids, all_attention_mask, all_decoder_input_ids,
+        rewards_per_sample, all_states_ixs, all_actions_ixs, all_dones,
     )
 
 
@@ -96,6 +149,7 @@ class ILQLTrainer(TPUTrainer):
         if not isinstance(config.method, ILQLConfig):
             raise ValueError("config.method must be ILQLConfig")
         self.ilql: ILQLConfig = config.method
+        self.seq2seq = config.model.model_arch_type == "seq2seq"
 
     def get_arch(self, config: TRLConfig):
         return build_model(
@@ -120,6 +174,30 @@ class ILQLTrainer(TPUTrainer):
     def make_loss_fn(self) -> Callable:
         model = self.model
         cfg = self.ilql
+        pad_id = self.tokenizer.pad_token_id
+
+        if self.seq2seq:
+            def seq2seq_loss_fn(train_params, frozen_params, batch):
+                params = merge_params(train_params, frozen_params)
+                decoder_attn_mask = (batch.decoder_input_ids != pad_id).astype(jnp.int32)
+                decoder_attn_mask = decoder_attn_mask.at[:, 0].set(1)
+                logits, qs, target_qs, vs, _ = model.apply(
+                    {"params": params},
+                    batch.input_ids,
+                    batch.attention_mask,
+                    batch.decoder_input_ids,
+                    decoder_attn_mask,
+                    states_ixs=batch.states_ixs,
+                    actions_ixs=batch.actions_ixs,
+                )
+                return ilql_loss(
+                    logits, qs, target_qs, vs,
+                    batch.decoder_input_ids, batch.actions_ixs, batch.dones, batch.rewards,
+                    tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
+                    awac_scale=cfg.awac_scale, beta=cfg.beta,
+                )
+
+            return seq2seq_loss_fn
 
         def loss_fn(train_params, frozen_params, batch: ILQLBatch):
             params = merge_params(train_params, frozen_params)
@@ -155,7 +233,15 @@ class ILQLTrainer(TPUTrainer):
         self.train_params, self.frozen_params = partition_params(params, mask)
 
     def make_experience(self, samples, rewards, max_length=2048):
-        self.store = make_experience(samples, rewards, self.tokenizer, max_length)
+        if self.seq2seq:
+            self.store = make_experience_seq2seq(
+                samples, rewards, self.tokenizer, max_length,
+                decoder_start_token_id=int(
+                    getattr(self.model_cfg, "decoder_start_token_id", self.tokenizer.pad_token_id)
+                ),
+            )
+        else:
+            self.store = make_experience(samples, rewards, self.tokenizer, max_length)
 
     def create_train_dataloader(self):
         return self.store.create_loader(

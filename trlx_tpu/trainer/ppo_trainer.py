@@ -22,6 +22,7 @@ from trlx_tpu.data.method_configs import MethodConfig, register_method
 from trlx_tpu.models import (
     build_model,
     forward_policy_and_ref,
+    forward_seq2seq_policy_and_ref,
     position_ids,
     ref_param_subtree,
 )
@@ -73,6 +74,7 @@ class PPOConfig(MethodConfig):
 class PPOTrainer(TPUTrainer):
     def __init__(self, config: TRLConfig, **kwargs):
         super().__init__(config, **kwargs)
+        self.seq2seq = config.model.model_arch_type == "seq2seq"
 
         self.store = PPORolloutStorage(
             self.tokenizer.pad_token_id, self.tokenizer.padding_side
@@ -131,6 +133,55 @@ class PPOTrainer(TPUTrainer):
         method = self.config.method
         pad_id = self.tokenizer.pad_token_id
 
+        if self.seq2seq:
+            # Encoder input = query, decoder input = response (starting with
+            # decoder_start); reference seq2seq loss path
+            # accelerate_ppo_trainer.py:147-174.
+            def seq2seq_loss_fn(train_params, frozen_params, batch: PPORLBatch):
+                params = merge_params(train_params, frozen_params)
+                query_tensors = batch.query_tensors
+                response_tensors = batch.response_tensors
+                old_logprobs = batch.logprobs
+                old_values = batch.values
+                old_rewards = batch.rewards
+                response_length = old_rewards.shape[1]
+
+                advantages, returns = get_advantages_and_returns(
+                    old_values, old_rewards, method.gamma, method.lam
+                )
+
+                attention_mask = (query_tensors != pad_id).astype(jnp.int32)
+                decoder_attention_mask = (response_tensors != pad_id).astype(jnp.int32)
+                decoder_attention_mask = decoder_attention_mask.at[:, 0].set(1)
+
+                logits, values_pred, _, _ = model.apply(
+                    {"params": params},
+                    query_tensors, attention_mask,
+                    response_tensors, decoder_attention_mask,
+                )
+                values_pred = values_pred[:, :-1]
+                logprobs = logprobs_of_labels(logits[:, :-1, :], response_tensors[:, 1:])
+                mask = decoder_attention_mask[:, 1:]
+
+                logprobs = logprobs[:, :response_length]
+                values_pred = values_pred[:, :response_length]
+                mask = mask[:, :response_length]
+
+                return ppo_loss(
+                    logprobs=logprobs,
+                    values=values_pred,
+                    old_logprobs=old_logprobs,
+                    old_values=old_values,
+                    advantages=advantages,
+                    returns=returns,
+                    mask=mask,
+                    cliprange=method.cliprange,
+                    cliprange_value=method.cliprange_value,
+                    vf_coef=method.vf_coef,
+                )
+
+            return seq2seq_loss_fn
+
         def loss_fn(train_params, frozen_params, batch: PPORLBatch):
             params = merge_params(train_params, frozen_params)
             query_tensors = batch.query_tensors
@@ -185,6 +236,27 @@ class PPOTrainer(TPUTrainer):
         model = self.model
         split = self.split
         pad_id = self.tokenizer.pad_token_id
+
+        if self.seq2seq:
+            def score_seq2seq(train_params, frozen_params, ref_params, query, response):
+                params = merge_params(train_params, frozen_params)
+                attention_mask = (query != pad_id).astype(jnp.int32)
+                decoder_attention_mask = (response != pad_id).astype(jnp.int32)
+                decoder_attention_mask = decoder_attention_mask.at[:, 0].set(1)
+                logits, values, ref_logits = forward_seq2seq_policy_and_ref(
+                    model, params, ref_params,
+                    query, attention_mask, response, decoder_attention_mask, split,
+                )
+                logprobs = logprobs_of_labels(logits[:, :-1, :], response[:, 1:])
+                ref_logprobs = logprobs_of_labels(ref_logits[:, :-1, :], response[:, 1:])
+                log_ratio = (logprobs - ref_logprobs) * decoder_attention_mask[:, 1:]
+                kl = jnp.exp(log_ratio) - 1 - log_ratio
+                mean_kl_per_token = kl.mean()
+                mean_kl = kl.sum(1).mean()
+                return logprobs, values[:, :-1], log_ratio, mean_kl, mean_kl_per_token
+
+            self._score_fn = jax.jit(score_seq2seq)
+            return
 
         def score(train_params, frozen_params, ref_params, all_tokens):
             params = merge_params(train_params, frozen_params)
@@ -263,9 +335,17 @@ class PPOTrainer(TPUTrainer):
                 self.tokenizer.encode(o, add_special_tokens=False)[:max_new]
                 for o in str_outputs
             ]
-            sample_outputs = np.full((n_samples, max_new), pad_id, dtype=np.int32)
-            for i, o in enumerate(outputs):
-                sample_outputs[i, : len(o)] = o
+            if self.seq2seq:
+                # decoder-side responses start with decoder_start_token
+                start_id = int(getattr(self.model_cfg, "decoder_start_token_id", pad_id))
+                sample_outputs = np.full((n_samples, 1 + max_new), pad_id, dtype=np.int32)
+                sample_outputs[:, 0] = start_id
+                for i, o in enumerate(outputs):
+                    sample_outputs[i, 1 : 1 + len(o)] = o
+            else:
+                sample_outputs = np.full((n_samples, max_new), pad_id, dtype=np.int32)
+                for i, o in enumerate(outputs):
+                    sample_outputs[i, : len(o)] = o
 
             if method.cliprange_reward:
                 scores = np.where(
@@ -289,11 +369,17 @@ class PPOTrainer(TPUTrainer):
                 scores = np.where(scores_mask, scores / max(self.ref_std, 1e-8), scores)
 
             # Jitted precompute of logprobs/values/ref KL
-            all_tokens = np.concatenate([prompt_tensors, sample_outputs], axis=1)
-            logprobs, values, log_ratio, mean_kl, mean_kl_per_token = self._score_fn(
-                self.train_params, self.frozen_params, self.ref_params,
-                jnp.asarray(all_tokens),
-            )
+            if self.seq2seq:
+                logprobs, values, log_ratio, mean_kl, mean_kl_per_token = self._score_fn(
+                    self.train_params, self.frozen_params, self.ref_params,
+                    jnp.asarray(prompt_tensors), jnp.asarray(sample_outputs),
+                )
+            else:
+                all_tokens = np.concatenate([prompt_tensors, sample_outputs], axis=1)
+                logprobs, values, log_ratio, mean_kl, mean_kl_per_token = self._score_fn(
+                    self.train_params, self.frozen_params, self.ref_params,
+                    jnp.asarray(all_tokens),
+                )
             logprobs = np.asarray(logprobs)
             values = np.asarray(values)
             log_ratio = np.asarray(log_ratio)
@@ -301,14 +387,20 @@ class PPOTrainer(TPUTrainer):
             mean_kl_per_token = float(np.asarray(mean_kl_per_token))
 
             # Slice per-sample response windows: logprob[i] is the (log)prob
-            # with which all_tokens[i+1] was sampled.
-            start = prompt_tensors.shape[1] - 1
+            # with which all_tokens[i+1] was sampled. For seq2seq everything
+            # is decoder-relative, so the window starts at 0.
+            start = 0 if self.seq2seq else prompt_tensors.shape[1] - 1
             kl_penalty = -self.kl_ctl.value * log_ratio
 
             for ix in range(n_samples):
-                n_resp = int((sample_outputs[ix] != pad_id).sum())
-                if n_resp == 0:
-                    n_resp = 1  # degenerate empty response: keep one slot
+                if self.seq2seq:
+                    n_resp = max(len(outputs[ix]), 1)
+                    response_tensor = sample_outputs[ix, : n_resp + 1]
+                else:
+                    n_resp = int((sample_outputs[ix] != pad_id).sum())
+                    if n_resp == 0:
+                        n_resp = 1  # degenerate empty response: keep one slot
+                    response_tensor = sample_outputs[ix, :n_resp]
                 end = start + n_resp
                 rewards = kl_penalty[ix, start:end].copy()
                 if scores.shape[1] == 1:
@@ -323,7 +415,7 @@ class PPOTrainer(TPUTrainer):
                 ppo_rl_elements.append(
                     PPORLElement(
                         query_tensor=prompt_tensors[ix],
-                        response_tensor=sample_outputs[ix, :n_resp],
+                        response_tensor=response_tensor,
                         logprobs=logprobs[ix, start:end],
                         values=values[ix, start:end],
                         rewards=rewards,

@@ -44,6 +44,11 @@ class TransformerConfig:
     use_bias: bool = True  # dense biases (gpt2 yes, llama no)
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
+    # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
+    # blockwise scan, trlx_tpu/ops/attention.py), "ring" (context-parallel
+    # over the "sequence" mesh axis, trlx_tpu/ops/ring_attention.py —
+    # requires running inside shard_map with that axis)
+    attn_impl: str = "xla"
 
     @property
     def kv_heads(self) -> int:
@@ -87,6 +92,7 @@ class Attention(nn.Module):
         positions: jnp.ndarray,  # [b, t]
         layer_cache: Optional[Dict[str, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
+        attn_mask: Optional[jnp.ndarray] = None,  # [b, t] key validity (fused paths)
     ):
         cfg = self.cfg
         b, t, d = h.shape
@@ -111,17 +117,32 @@ class Attention(nn.Module):
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv}
 
-        if nkv != nh:  # GQA: repeat kv heads
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if cfg.attn_impl in ("flash", "ring") and layer_cache is None and attn_mask is not None:
+            # Fused training/scoring path: causal + key-padding structure is
+            # computed inside the kernel from `attn_mask`; `attn_bias` is
+            # ignored (it encodes exactly that structure, causal_bias below).
+            # K/V stay at n_kv_heads — the kernels map q-heads to kv-heads
+            # per block, so GQA never inflates KV residency or ring traffic.
+            if cfg.attn_impl == "ring":
+                from trlx_tpu.ops.ring_attention import ring_attention
 
-        scale = 1.0 / np.sqrt(hd)
-        # [b, h, t, S] — accumulate scores in f32 for stability.
-        scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32) * scale
-        scores = scores + attn_bias  # bias is f32, -inf on masked
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+                out = ring_attention(q, k, v, mask=attn_mask, causal=True)
+            else:
+                from trlx_tpu.ops.attention import flash_attention
+
+                out = flash_attention(q, k, v, mask=attn_mask, causal=True)
+            out = out.astype(cfg.dtype)
+        else:
+            if nkv != nh:  # GQA: repeat kv heads for the dense einsum path
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            scale = 1.0 / np.sqrt(hd)
+            # [b, h, t, S] — accumulate scores in f32 for stability.
+            scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32) * scale
+            scores = scores + attn_bias  # bias is f32, -inf on masked
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhts,bshd->bthd", probs, v)
         out = out.reshape(b, t, nh * hd)
         out = dense(d, "o_proj")(out)
         return out, new_cache
@@ -147,10 +168,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None):
+    def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None, attn_mask=None):
         cfg = self.cfg
         attn_out, new_cache = Attention(cfg, name="attn")(
-            make_norm(cfg, "ln_attn")(h), attn_bias, positions, layer_cache, cache_index
+            make_norm(cfg, "ln_attn")(h), attn_bias, positions, layer_cache, cache_index, attn_mask
         )
         h = h + attn_out
         h = h + MLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
@@ -214,11 +235,26 @@ class TransformerLM(nn.Module):
             logits = self.lm_head(h_final)
         return logits, h_final
 
-    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None):
+    def _default_positions(self, tokens_or_h, attn_mask):
+        """Position ids when the caller didn't supply them. Under ring
+        attention the model runs inside shard_map with the sequence dim
+        sharded, so a local cumsum would restart at 0 on every shard —
+        instead use the shard's global offset (assumes right-padded
+        batches, which long-context training uses). Other impls keep the
+        left-padding-robust cumsum."""
+        if self.cfg.attn_impl == "ring":
+            t = attn_mask.shape[-1]
+            offset = jax.lax.axis_index("sequence") * t
+            return offset + jnp.broadcast_to(
+                jnp.arange(t, dtype=jnp.int32)[None, :], attn_mask.shape
+            )
+        return position_ids(attn_mask)
+
+    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None):
         new_layers = [] if cache is not None else None
         for i in range(start, stop):
             layer_cache = cache[i] if cache is not None else None
-            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index)
+            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index, attn_mask)
             if cache is not None:
                 new_layers.append(new_cache)
         return h, new_layers
@@ -233,12 +269,15 @@ class TransformerLM(nn.Module):
         """Training/scoring forward (no cache). Returns (logits, h_split,
         h_final) where h_split is the activation entering block `split`."""
         if positions is None:
-            positions = position_ids(attn_mask)
-        bias = causal_bias(attn_mask)
+            positions = self._default_positions(tokens, attn_mask)
+        fused = self.cfg.attn_impl in ("flash", "ring")
+        # Fused kernels build causal+padding structure from attn_mask
+        # blockwise — skip materializing the O(t^2) bias tensor entirely.
+        bias = None if fused else causal_bias(attn_mask)
         h = self.embed(tokens, positions)
-        h, _ = self.run_blocks(h, bias, positions, 0, split)
+        h, _ = self.run_blocks(h, bias, positions, 0, split, attn_mask=attn_mask)
         h_split = h
-        h, _ = self.run_blocks(h, bias, positions, split, self.cfg.n_layers)
+        h, _ = self.run_blocks(h, bias, positions, split, self.cfg.n_layers, attn_mask=attn_mask)
         logits, h_final = self.unembed(h)
         return logits, h_split, h_final
 
@@ -253,9 +292,10 @@ class TransformerLM(nn.Module):
         state — the hydra frozen branch (reference forward_hydra,
         modeling_ppo.py:410-453) when applied with reference params."""
         if positions is None:
-            positions = position_ids(attn_mask)
-        bias = causal_bias(attn_mask)
-        h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers)
+            positions = self._default_positions(h, attn_mask)
+        fused = self.cfg.attn_impl in ("flash", "ring")
+        bias = None if fused else causal_bias(attn_mask)
+        h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers, attn_mask=attn_mask)
         logits, _ = self.unembed(h)
         return logits
 

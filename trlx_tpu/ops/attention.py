@@ -1,0 +1,327 @@
+"""Fused attention for the TPU hot path.
+
+The reference delegates fused/flash attention to NeMo TransformerEngine
+hooks (SURVEY.md §2.6: nemo cfg `transformer_engine`,
+nemo_ppo_trainer.py:348-349) — a CUDA dependency. Here it is a first-class
+op with three tiers:
+
+1. `flash_attention` — Pallas TPU kernel (blockwise online-softmax, grid
+   over (batch*heads, q-blocks, kv-blocks), VMEM accumulators). Forward
+   only; the backward pass recomputes via tier 2 under `jax.custom_vjp`,
+   so peak memory never materializes the [t, t] score matrix in either
+   direction.
+2. `blockwise_attention` — pure-XLA `lax.scan` over KV blocks with the
+   same online-softmax math. Differentiable, runs anywhere (CPU tests),
+   and is the building block ring attention reuses per ring hop
+   (trlx_tpu/ops/ring_attention.py).
+3. the naive einsum path in models/transformer.py for short sequences
+   where fusion doesn't matter.
+
+Layouts: q, k, v are [b, t, nh, hd] (model layout); `mask` is the [b, S]
+key-validity mask. Causal structure is computed from block indices inside
+the kernel instead of an O(t^2) bias tensor.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _pick_block(n: int, target: int = 128) -> int:
+    """Largest divisor of n that is <= target (TPU-friendly when n is a
+    multiple of 128; degrades gracefully for tiny test shapes)."""
+    b = min(n, target)
+    while n % b != 0:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: blockwise XLA attention (differentiable reference + ring building
+# block). Online softmax: carry (acc, m, l) across KV blocks.
+# ---------------------------------------------------------------------------
+
+
+def _attend_block(q, k, v, bias, acc, m, l, scale):
+    """One online-softmax update. q: [b, tq, nh, hd]; k, v: [b, tk, nh, hd];
+    bias: broadcastable to [b, nh, tq, tk] additive f32 (0 or NEG_INF);
+    acc: [b, tq, nh, hd] f32; m, l: [b, nh, tq] f32. Returns updated
+    (acc, m, l)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale + bias
+    m_cur = jnp.max(s, axis=-1)  # [b, nh, tq]
+    m_new = jnp.maximum(m, m_cur)
+    # Fully-masked-so-far rows keep m == NEG_INF; exp(s - NEG_INF) would
+    # explode to exp(0)=1 on masked entries, so clamp the shift.
+    shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - shift[..., None])  # [b, nh, tq, tk]
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    correction = jnp.exp(m - m_new)
+    correction = jnp.where(m <= NEG_INF / 2, 0.0, correction)
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + pv
+    return acc_new, m_new, l_new
+
+
+def _finalize(acc, l):
+    l_t = l.transpose(0, 2, 1)[..., None]  # [b, tq, nh, 1]
+    return jnp.where(l_t > 0, acc / jnp.maximum(l_t, 1e-30), 0.0)
+
+
+def init_carry(q32: jnp.ndarray):
+    """Fresh online-softmax carry (acc, m, l), derived from q so it carries
+    q's sharding/varying-axes type (required for scan carries under
+    shard_map)."""
+    zero_rows = jnp.transpose(q32[..., 0], (0, 2, 1)) * 0.0  # [b, nh, tq]
+    return (q32 * 0.0, zero_rows + NEG_INF, zero_rows)
+
+
+def blockwise_update(
+    q32: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray],
+    carry,
+    causal: bool = True,
+    block_k: int = 128,
+    q_offset=0,
+    k_offset=0,
+):
+    """Fold one KV chunk into an online-softmax carry, scanning the chunk in
+    `block_k` blocks. `q_offset`/`k_offset` shift the causal comparison for
+    ring/sharded use (global position = local index + offset; offsets may be
+    traced scalars). Returns the updated carry — `_finalize` turns it into
+    the attention output."""
+    b, tq, nh, hd = q32.shape
+    tk, nkv = k.shape[1], k.shape[2]
+    group = nh // nkv  # GQA: kv stays at nkv heads; repeat per block only
+    scale = 1.0 / np.sqrt(hd)
+    bk = _pick_block(tk, block_k)
+    nblocks = tk // bk
+
+    rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, 1), 0)  # [tq, 1]
+    kb = k.reshape(b, nblocks, bk, nkv, hd)
+    vb = v.reshape(b, nblocks, bk, nkv, hd)
+    maskb = None if mask is None else mask.reshape(b, nblocks, bk)
+
+    def body(carry, blk):
+        acc, m, l = carry
+        kblk, vblk, mblk, idx = blk
+        if group > 1:
+            kblk = jnp.repeat(kblk, group, axis=2)  # [b, bk, nh, hd] temp
+            vblk = jnp.repeat(vblk, group, axis=2)
+        cols = k_offset + idx * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        allowed = jnp.ones((tq, bk), dtype=bool)
+        if causal:
+            allowed = cols <= rows  # [tq, bk]
+        bias = jnp.where(allowed, 0.0, NEG_INF)[None, None]  # [1, 1, tq, bk]
+        if mblk is not None:
+            bias = bias + jnp.where(mblk[:, None, None, :].astype(bool), 0.0, NEG_INF)
+        acc, m, l = _attend_block(q32, kblk, vblk, bias, acc, m, l, scale)
+        return (acc, m, l), None
+
+    xs = (
+        kb.transpose(1, 0, 2, 3, 4),
+        vb.transpose(1, 0, 2, 3, 4),
+        None if maskb is None else maskb.transpose(1, 0, 2),
+        jnp.arange(nblocks),
+    )
+    carry, _ = jax.lax.scan(body, carry, xs)
+    return carry
+
+
+def blockwise_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    block_k: int = 128,
+    q_offset=0,
+    k_offset=0,
+) -> jnp.ndarray:
+    """Memory-efficient attention: scan over KV blocks, never building the
+    full [t, S] matrix as a saved residual. Differentiable (scan autodiff)."""
+    q32 = q.astype(jnp.float32)
+    carry = blockwise_update(
+        q32, k, v, mask, init_carry(q32),
+        causal=causal, block_k=block_k, q_offset=q_offset, k_offset=k_offset,
+    )
+    acc, _, l = carry
+    return _finalize(acc, l).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: Pallas TPU kernel (forward). Grid (b*nh, nq, nk); VMEM scratch
+# carries (m, l, acc) across the kv-block dimension of the grid.
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr,
+                      *, scale, causal, block_q, block_k):
+    import jax.experimental.pallas as pl
+
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: the whole kv block is in the future of the whole q block →
+    # nothing to do. (Predicated out rather than skipped — grid is static.)
+    run = jnp.asarray(True)
+    if causal:
+        run = (kb * block_k) <= (qb * block_q + block_q - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [bq, hd]
+        k = k_ref[0].astype(jnp.float32)  # [bk, hd]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [bq, bk]
+
+        valid = mask_ref[0] > 0  # [1, bk] int mask row
+        allowed = jnp.broadcast_to(valid, (block_q, block_k))
+        if causal:
+            rows = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            allowed = allowed & (cols <= rows)
+        s = jnp.where(allowed, s, NEG_INF)
+
+        m_prev = m_scr[:, 0]  # [bq]
+        l_prev = l_scr[:, 0]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - shift[:, None])
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m_prev - m_new)
+        corr = jnp.where(m_prev <= NEG_INF / 2, 0.0, corr)
+        l_new = l_prev * corr + jnp.sum(p, axis=1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(kb == nk - 1)
+    def _finalize_out():
+        l = l_scr[:, 0]
+        denom = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k, interpret=False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, nh, hd = q.shape
+    tk, nkv = k.shape[1], k.shape[2]
+    group = nh // nkv
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+    scale = 1.0 / np.sqrt(hd)
+
+    # [b*nh, t, hd] q layout; k/v stay at [b*nkv, t, hd] — the index maps
+    # below route each q-head grid slot to its kv head (GQA) and each
+    # batch-head slot to its batch's mask row, with zero duplication in HBM.
+    qh = q.transpose(0, 2, 1, 3).reshape(b * nh, tq, hd)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * nkv, tk, hd)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * nkv, tk, hd)
+    if mask is None:
+        mask = jnp.ones((b, tk), jnp.int32)
+    maskh = mask.astype(jnp.int32)[:, None, :]  # [b, 1, tk]
+
+    def kv_index(i, j, kk):
+        return ((i // nh) * nkv + (i % nh) // group, kk, 0)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * nh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, hd), lambda i, j, kk: (i, j, 0)),
+            pl.BlockSpec((1, bk, hd), kv_index),
+            pl.BlockSpec((1, bk, hd), kv_index),
+            pl.BlockSpec((1, 1, bk), lambda i, j, kk: (i // nh, 0, kk)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, hd), lambda i, j, kk: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * nh, tq, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # m (broadcast over lanes)
+            pltpu.VMEM((bq, 128), jnp.float32),  # l
+            pltpu.VMEM((bq, hd), jnp.float32),   # acc
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, maskh)
+    return out.reshape(b, nh, tq, hd).transpose(0, 2, 1, 3)
+
+
+def _use_pallas() -> bool:
+    # The Pallas call carries no GSPMD partitioning rule, so under a
+    # multi-device jit XLA would replicate its operands instead of splitting
+    # the batch. Single chip → Pallas kernel; multi-chip GSPMD → blockwise
+    # XLA (fully partitionable; same math). Ring attention owns the
+    # sequence-sharded case via shard_map.
+    try:
+        return jax.default_backend() == "tpu" and jax.device_count() == 1
+    except Exception:
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention(q, k, v, mask, causal, block_q, block_k):
+    if _use_pallas():
+        return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    return blockwise_attention(q, k, v, mask, causal, block_k)
+
+
+def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k):
+    out = _flash_attention(q, k, v, mask, causal, block_q, block_k)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, res, g):
+    # Recompute-based backward through the blockwise XLA path: memory stays
+    # O(t · block) and XLA fuses the recomputation with the grad math.
+    q, k, v, mask = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, mask, causal, block_k),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Fused attention. q,k,v: [b, t, nh, hd]; mask: [b, S] key validity
+    (1 = real). Returns [b, t, nh, hd]. On TPU the forward runs as a
+    Pallas kernel; elsewhere (and for the backward pass) the blockwise XLA
+    path is used."""
+    return _flash_attention(q, k, v, mask, causal, block_q, block_k)

@@ -1,0 +1,83 @@
+"""Ring attention: context parallelism over the "sequence" mesh axis.
+
+The reference's only long-sequence mechanism is Megatron SP — activations
+sharded along sequence *within* a TP group, with explicit gathers
+(SURVEY.md §5.7: sequence length never scales past one TP group's memory;
+max context 2048 in every shipped config). Ring attention goes beyond
+that: Q/K/V are sharded along sequence across the ring, each device
+computes blockwise attention against its resident KV chunk, then KV
+chunks rotate one hop around the ring via `ppermute` (ICI
+nearest-neighbor) until every device has seen every chunk. Online-softmax
+carries (acc, m, l) make the result exact — memory per device is
+O(t/ring), and comm per hop is the KV chunk, overlapped with compute by
+XLA's async collective scheduling.
+
+Must run inside `shard_map` (or an equivalent named-axis context) where
+`axis_name` maps to the mesh's "sequence" axis and inputs arrive as the
+per-device shards [b, t_local, nh, hd]. `trlx_tpu.parallel.context.
+context_parallel_attention` wraps the shard_map plumbing.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.ops.attention import _finalize, blockwise_update, init_carry
+
+SEQUENCE_AXIS = "sequence"
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    axis_name: str = SEQUENCE_AXIS,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on `axis_name`.
+
+    q, k, v: local shards [b, t_local, nh, hd] (sequence dim sharded in
+    order: global position = axis_index * t_local + local index).
+    mask: local [b, t_local] key-validity shard. Returns the local output
+    shard [b, t_local, nh, hd].
+    """
+    size = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, tq, nh, hd = q.shape
+    tk = k.shape[1]
+    if mask is None:
+        mask = jnp.ones((b, tk), jnp.int32)
+
+    q32 = q.astype(jnp.float32)
+    q_offset = idx * tq
+    carry = init_carry(q32)
+    perm = [(d, (d + 1) % size) for d in range(size)]
+
+    for hop in range(size):
+        src = (idx - hop) % size  # rank whose KV chunk we currently hold
+        k_offset = src * tk
+
+        def attend(carry, k=k, v=v, mask=mask, k_offset=k_offset):
+            return blockwise_update(
+                q32, k, v, mask, carry,
+                causal=causal, block_k=block_k,
+                q_offset=q_offset, k_offset=k_offset,
+            )
+
+        if causal:
+            # Whole chunk in this query shard's future → skip its FLOPs.
+            # (k_offset is traced; lax.cond keeps the graph static.)
+            carry = jax.lax.cond(
+                k_offset > q_offset + tq - 1, lambda c: c, attend, carry
+            )
+        else:
+            carry = attend(carry)
+
+        if hop != size - 1:  # rotate KV one hop around the ring
+            k, v, mask = jax.lax.ppermute((k, v, mask), axis_name, perm)
+
+    acc, _, l = carry
+    return _finalize(acc, l).astype(q.dtype)

@@ -1,0 +1,48 @@
+"""Context-parallel plumbing: shard_map wrappers for ring attention.
+
+The reference has no context parallelism at all (SURVEY.md §2.7: CP/ring
+attention row is "none"); this module is the scale-out path the TPU build
+adds. `ring_attention` itself (trlx_tpu/ops/ring_attention.py) is written
+against a named axis; this wrapper binds it to a concrete mesh so callers
+holding global (or GSPMD-sharded) arrays can use it directly.
+"""
+
+import functools
+from typing import Optional
+
+import jax.numpy as jnp
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from trlx_tpu.ops.ring_attention import ring_attention
+
+
+def context_parallel_attention(
+    mesh: Mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    block_k: int = 128,
+) -> jnp.ndarray:
+    """Exact attention with the sequence dim sharded over the mesh's
+    "sequence" axis and batch over ("data", "fsdp"). Inputs are global
+    [b, t, nh, hd] arrays (jit will reshard as needed); output has the
+    same global shape/sharding."""
+    qkv_spec = P(("data", "fsdp"), "sequence", None, None)
+    mask_spec = P(("data", "fsdp"), "sequence")
+
+    fn = shard_map(
+        functools.partial(ring_attention, causal=causal, block_k=block_k),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+    )
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], jnp.int32)
+    return fn(q, k, v, mask)

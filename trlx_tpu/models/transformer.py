@@ -243,9 +243,14 @@ class TransformerLM(nn.Module):
         batches, which long-context training uses). Other impls keep the
         left-padding-robust cumsum."""
         if self.cfg.attn_impl == "ring":
+            try:
+                offset = jax.lax.axis_index("sequence")
+            except Exception:
+                # Axis unbound (e.g. flax param init outside shard_map) —
+                # treat as the single-shard case.
+                offset = 0
             t = attn_mask.shape[-1]
-            offset = jax.lax.axis_index("sequence") * t
-            return offset + jnp.broadcast_to(
+            return offset * t + jnp.broadcast_to(
                 jnp.arange(t, dtype=jnp.int32)[None, :], attn_mask.shape
             )
         return position_ids(attn_mask)

@@ -89,8 +89,9 @@ class MeshRuntime:
     def from_config(cls, parallel_config, devices=None) -> "MeshRuntime":
         if getattr(parallel_config, "pipeline", 1) not in (1, None):
             raise NotImplementedError(
-                "parallel.pipeline > 1 is not implemented yet; use "
-                "data/fsdp/tensor/sequence axes"
+                "parallel.pipeline > 1 is not wired into the GSPMD trainer "
+                "family yet; use trlx_tpu.parallel.pipeline.make_gpipe_forward "
+                "for pipelined forwards, or data/fsdp/tensor/sequence axes here"
             )
         mesh = make_mesh(
             data=parallel_config.data,

@@ -1,0 +1,201 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a "pipe" mesh axis.
+
+The reference's PP comes from the Apex pipeline engine — Python-driven
+send/recv of tensor_shape-tagged activations between PP ranks with a
+microbatch calculator and fwd/bwd schedule (SURVEY.md §2.6:
+modeling_nemo_ppo.py:713-731, per-stage model construction :497-536, PP
+checkpoint resharding :321-352). The TPU-native design needs none of that
+machinery: transformer blocks are homogeneous, so per-stage "model
+surgery" collapses to *stacking* block params [n_stages, layers_per_stage,
+...] and sharding the leading dim over the "pipe" axis. One `shard_map`
+program then runs the classic GPipe schedule:
+
+    tick r ∈ [0, M + S - 1):
+      stage 0 ingests microbatch r (clamped);
+      every stage applies its layer stack to its current activation;
+      `ppermute` hands activations (+ their padding masks) one hop down;
+      the last stage banks finished microbatches.
+
+Warmup/drain bubbles are predicated out with `where` instead of skipped —
+the graph stays static and XLA overlaps the ppermute with the next tick's
+compute. The backward pass is pure autodiff: transposing `ppermute`
+reverses the ring, so the reverse-pipeline schedule falls out of
+`jax.grad` with no hand-written 1F1B engine. Embedding/unembedding are
+replicated compute on every stage (negligible next to the block stack;
+removes the reference's first/last-stage embedding-sync all-reduce,
+modeling_nemo_ppo.py:765-769).
+"""
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from trlx_tpu.models.transformer import (
+    Block,
+    TransformerConfig,
+    causal_bias,
+    position_ids,
+)
+
+PIPE_AXIS = "pipe"
+
+
+def _varying(x, axis_name: str):
+    """Mark a replicated value as device-varying over `axis_name` so it can
+    seed a shard_map scan carry whose outputs vary (jax>=0.8 VMA types)."""
+    try:
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):  # older jax: no VMA tracking
+        return x
+
+
+def make_pipe_mesh(n_stages: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) % n_stages != 0:
+        raise ValueError(f"{len(devices)} devices not divisible into {n_stages} stages")
+    # Any extra devices form a leading data axis for DP x PP hybrids.
+    arr = np.asarray(devices).reshape(len(devices) // n_stages, n_stages)
+    return Mesh(arr, ("data", PIPE_AXIS))
+
+
+def stack_block_params(params: Dict, n_layers: int, n_stages: int) -> Tuple[Dict, Dict]:
+    """Split a TransformerLM param tree into (stacked block params with
+    leading [n_stages, layers_per_stage], non-block params). The inverse of
+    the reference's per-stage model_provider_func — no surgery, just a
+    pytree reshape."""
+    if n_layers % n_stages != 0:
+        raise ValueError(f"n_layers={n_layers} not divisible by n_stages={n_stages}")
+    inner = params["params"] if "params" in params else params
+    blocks = [inner[f"block_{i}"] for i in range(n_layers)]
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    lps = n_layers // n_stages
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape(n_stages, lps, *x.shape[1:]), stacked
+    )
+    rest = {k: v for k, v in inner.items() if not k.startswith("block_")}
+    return stacked, rest
+
+
+def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions):
+    """Sequentially apply this stage's layers via lax.scan over the stacked
+    param dim (static per-layer graph, compiled once)."""
+    block = Block(cfg)
+
+    def body(h, lp):
+        h, _ = block.apply({"params": lp}, h, bias, positions)
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, layer_params)
+    return h
+
+
+def gpipe_blocks(
+    cfg: TransformerConfig,
+    stage_params,  # local [1, lps, ...] pytree (sharded over pipe axis)
+    h: jnp.ndarray,  # [B, t, d] full batch (replicated across pipe axis)
+    attn_mask: jnp.ndarray,  # [B, t]
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Run the block stack as a GPipe pipeline. Must be called inside
+    shard_map with `axis_name` bound. Returns [B, t, d] (valid on every
+    stage — the final activations are broadcast from the last stage)."""
+    S = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    my_layers = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+
+    B, t, d = h.shape
+    M = n_microbatches
+    assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
+    mb = B // M
+    h_mbs = h.reshape(M, mb, t, d)
+    mask_mbs = attn_mask.reshape(M, mb, t)
+
+    def stage(x, mask):
+        positions = position_ids(mask)
+        bias = causal_bias(mask)
+        return _apply_layer_stack(cfg, my_layers, x, bias, positions)
+
+    fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
+
+    def tick(carry, r):
+        recv_h, recv_mask, out = carry
+        r_in = jnp.clip(r, 0, M - 1)
+        mb_h = jax.lax.dynamic_index_in_dim(h_mbs, r_in, 0, keepdims=False)
+        mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, r_in, 0, keepdims=False)
+        x = jnp.where(idx == 0, mb_h, recv_h)
+        mask = jnp.where(idx == 0, mb_mask, recv_mask)
+        y = stage(x, mask)
+
+        write_idx = jnp.clip(r - (S - 1), 0, M - 1)
+        banked = jax.lax.dynamic_update_index_in_dim(out, y, write_idx, 0)
+        out = jnp.where((r >= S - 1) & (idx == S - 1), banked, out)
+
+        next_h, next_mask = jax.lax.ppermute((y, mask), axis_name, fwd_perm)
+        return (next_h, next_mask, out), None
+
+    out0 = jnp.zeros((M, mb, t, d), h.dtype)
+    init = jax.tree_util.tree_map(
+        lambda x: _varying(x, axis_name),
+        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]), out0),
+    )
+    (_, _, out), _ = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
+
+    # Broadcast the finished activations from the last stage to all stages
+    # (mask-and-psum; one collective, lets unembed/loss run replicated).
+    out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
+    return out.reshape(B, t, d)
+
+
+def make_gpipe_forward(
+    model,  # TransformerLM (or a module exposing embed/unembed + blocks)
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_stages: int,
+    n_microbatches: int,
+) -> Callable:
+    """Build fn(params, tokens, attn_mask) -> logits running the block
+    stack as a GPipe pipeline over `mesh`'s "pipe" axis. Params are taken
+    in standard (unstacked) TransformerLM layout; stacking happens inside
+    the jitted fn so the same checkpoint format serves every layout (the
+    reference instead reshards checkpoints per PP stage,
+    modeling_nemo_ppo.py:321-352)."""
+
+    def embed_unembed(rest_params, tokens, attn_mask, h_mid):
+        """Non-block compute, replicated on every stage."""
+        wrapped = {"params": {**rest_params}}
+        if h_mid is None:  # embedding
+            positions = position_ids(attn_mask)
+            return model.apply(wrapped, tokens, positions, method=model.embed)
+        logits, _ = model.apply(wrapped, h_mid, method=model.unembed)
+        return logits
+
+    def fwd(params, tokens, attn_mask):
+        stacked, rest = stack_block_params(params, cfg.n_layers, n_stages)
+
+        def inner(stacked, rest, tokens, attn_mask):
+            h = embed_unembed(rest, tokens, attn_mask, None)
+            h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
+            return embed_unembed(rest, tokens, attn_mask, h)
+
+        # Batch sharded over the mesh's "data" axis (DP x PP hybrid: each
+        # data slice runs its own pipeline over the shared stage params);
+        # shard_map's transpose inserts the data-axis grad psum for the
+        # replicated params automatically.
+        return shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P(PIPE_AXIS), P(), P("data"), P("data")),
+            out_specs=P("data"),
+        )(stacked, rest, tokens, attn_mask)
+
+    return fwd

@@ -2,6 +2,11 @@
 examples/randomwalks/ppo_randomwalks.py, from-scratch tiny model +
 char tokenizer instead of the CarperAI/randomwalks checkpoint)."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
 import trlx_tpu as trlx
 from examples.randomwalks import generate_random_walks
 from trlx_tpu.data.configs import (

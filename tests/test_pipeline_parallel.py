@@ -21,7 +21,7 @@ from trlx_tpu.parallel.pipeline import (
 @pytest.fixture(scope="module")
 def setup():
     cfg = TransformerConfig(
-        vocab_size=89, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        vocab_size=89, d_model=32, n_layers=8, n_heads=4, d_ff=64,
         max_seq_len=32, dtype=jnp.float32,
     )
     model = TransformerLM(cfg)
@@ -37,7 +37,7 @@ def test_stack_block_params_roundtrip(setup):
     cfg, model, params, *_ = setup
     stacked, rest = stack_block_params(params, cfg.n_layers, 2)
     leaf = jax.tree_util.tree_leaves(stacked)[0]
-    assert leaf.shape[:2] == (2, 2)
+    assert leaf.shape[:2] == (2, cfg.n_layers // 2)
     assert "embed_tokens" in rest and not any(k.startswith("block_") for k in rest)
 
 
@@ -48,6 +48,26 @@ def test_gpipe_matches_sequential(setup, n_stages, n_mb):
         pytest.skip("layers not divisible")
     mesh = make_pipe_mesh(n_stages)
     fwd = jax.jit(make_gpipe_forward(model, cfg, mesh, n_stages, n_mb))
+    logits_pp = fwd(params, tokens, mask)
+    logits_seq, _, _ = model.apply(params, tokens, mask)
+    valid = np.asarray(mask)[:, :, None].astype(bool)
+    np.testing.assert_allclose(
+        np.where(valid, np.asarray(logits_pp), 0),
+        np.where(valid, np.asarray(logits_seq), 0),
+        atol=1e-4, rtol=1e-4,
+    )
+
+
+def test_gpipe_fused_attention_matches_sequential(setup):
+    """The pipeline stage must forward attn_mask so fused (flash) attention
+    engages instead of silently falling back to the O(t^2) dense path."""
+    cfg, model, params, tokens, mask = setup
+    from dataclasses import replace
+
+    fcfg = replace(cfg, attn_impl="flash")
+    fmodel = TransformerLM(fcfg)
+    mesh = make_pipe_mesh(4)
+    fwd = jax.jit(make_gpipe_forward(fmodel, fcfg, mesh, 4, 4))
     logits_pp = fwd(params, tokens, mask)
     logits_seq, _, _ = model.apply(params, tokens, mask)
     valid = np.asarray(mask)[:, :, None].astype(bool)

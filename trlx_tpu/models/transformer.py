@@ -245,9 +245,10 @@ class TransformerLM(nn.Module):
         if self.cfg.attn_impl == "ring":
             try:
                 offset = jax.lax.axis_index("sequence")
-            except Exception:
+            except NameError:
                 # Axis unbound (e.g. flax param init outside shard_map) —
-                # treat as the single-shard case.
+                # single-shard case; ring_attention likewise degrades to
+                # plain blockwise attention when the axis is unbound.
                 offset = 0
             t = attn_mask.shape[-1]
             return offset * t + jnp.broadcast_to(

@@ -44,8 +44,16 @@ def ring_attention(
     mask: local [b, t_local] key-validity shard. Returns the local output
     shard [b, t_local, nh, hd].
     """
-    size = jax.lax.psum(1, axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    try:
+        size = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+    except NameError:
+        # Axis unbound — e.g. flax param init or a single-host forward
+        # outside shard_map. The ring degenerates to one shard holding the
+        # whole sequence: plain blockwise attention is exact.
+        from trlx_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, mask=mask, causal=causal, block_k=block_k)
     b, tq, nh, hd = q.shape
     tk = k.shape[1]
     if mask is None:

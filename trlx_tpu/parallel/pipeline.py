@@ -62,8 +62,16 @@ def make_pipe_mesh(n_stages: int, devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     if len(devices) % n_stages != 0:
         raise ValueError(f"{len(devices)} devices not divisible into {n_stages} stages")
-    # Any extra devices form a leading data axis for DP x PP hybrids.
-    arr = np.asarray(devices).reshape(len(devices) // n_stages, n_stages)
+    # Any extra devices form a leading data axis for DP x PP hybrids. Use
+    # mesh_utils placement so consecutive pipe stages land on neighboring
+    # ICI links (the per-tick ppermute hop), mirroring make_mesh.
+    sizes = (len(devices) // n_stages, n_stages)
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:  # CPU/host meshes without topology info
+        arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, ("data", PIPE_AXIS))
 
 
@@ -85,13 +93,13 @@ def stack_block_params(params: Dict, n_layers: int, n_stages: int) -> Tuple[Dict
     return stacked, rest
 
 
-def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions):
+def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions, attn_mask):
     """Sequentially apply this stage's layers via lax.scan over the stacked
     param dim (static per-layer graph, compiled once)."""
     block = Block(cfg)
 
     def body(h, lp):
-        h, _ = block.apply({"params": lp}, h, bias, positions)
+        h, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
         return h, None
 
     h, _ = jax.lax.scan(body, h, layer_params)
@@ -122,8 +130,11 @@ def gpipe_blocks(
 
     def stage(x, mask):
         positions = position_ids(mask)
-        bias = causal_bias(mask)
-        return _apply_layer_stack(cfg, my_layers, x, bias, positions)
+        # Fused attention impls build causal+padding structure blockwise
+        # from the mask — skip the O(t^2) bias tensor (as in
+        # TransformerLM.__call__, transformer.py:278-281).
+        bias = None if cfg.attn_impl in ("flash", "ring") else causal_bias(mask)
+        return _apply_layer_stack(cfg, my_layers, x, bias, positions, mask)
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
 
@@ -143,7 +154,10 @@ def gpipe_blocks(
         next_h, next_mask = jax.lax.ppermute((y, mask), axis_name, fwd_perm)
         return (next_h, next_mask, out), None
 
-    out0 = jnp.zeros((M, mb, t, d), h.dtype)
+    # Derive the output bank from `h` (not a fresh jnp.zeros) so it carries
+    # h's varying-axis type (e.g. "data" in DP x PP hybrids) — the scan carry
+    # must type-match the stage outputs it accumulates.
+    out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
         (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]), out0),

@@ -1,0 +1,158 @@
+"""Model-family parity: our single parameterized TransformerLM vs the HF
+torch implementations the reference wraps per-architecture
+(trlx/models/modeling_ppo.py:502-1222, hf_get_branch_class :1598-1637).
+
+For each family a tiny randomly-initialized HF model is saved to disk,
+converted through trlx_tpu.models.hf_interop, and checked for exact logits
+parity (f32) — this covers both the converter layouts (fused qkv, rotary
+conventions, ALiBi, position offsets) and the architecture flags
+(parallel residual, partial rotary, shared LN, MQA).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+torch = pytest.importorskip("torch")
+
+from trlx_tpu.models import CausalLMWithValueHead  # noqa: E402
+from trlx_tpu.models import hf_interop  # noqa: E402
+
+VOCAB, SEQ = 128, 16
+
+
+def _tiny_hf_model(family):
+    import transformers as tf
+
+    common = dict(vocab_size=VOCAB)
+    if family == "gpt2":
+        cfg = tf.GPT2Config(n_positions=64, n_embd=32, n_layer=2, n_head=4, **common)
+        cls = tf.GPT2LMHeadModel
+    elif family == "llama":
+        cfg = tf.LlamaConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, **common,
+        )
+        cls = tf.LlamaForCausalLM
+    elif family == "gpt_neox":
+        cfg = tf.GPTNeoXConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, rotary_pct=0.25, max_position_embeddings=64,
+            use_parallel_residual=True, **common,
+        )
+        cls = tf.GPTNeoXForCausalLM
+    elif family == "gptj":
+        cfg = tf.GPTJConfig(
+            n_positions=64, n_embd=32, n_layer=2, n_head=4, rotary_dim=4, **common
+        )
+        cls = tf.GPTJForCausalLM
+    elif family == "opt":
+        cfg = tf.OPTConfig(
+            hidden_size=32, ffn_dim=64, num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, do_layer_norm_before=True,
+            word_embed_proj_dim=32, **common,
+        )
+        cls = tf.OPTForCausalLM
+    elif family == "bloom":
+        cfg = tf.BloomConfig(hidden_size=32, n_layer=2, n_head=4, **common)
+        cls = tf.BloomForCausalLM
+    elif family == "gpt_bigcode":
+        cfg = tf.GPTBigCodeConfig(
+            n_positions=64, n_embd=32, n_layer=2, n_head=4, multi_query=True, **common
+        )
+        cls = tf.GPTBigCodeForCausalLM
+    else:
+        raise ValueError(family)
+    torch.manual_seed(0)
+    model = cls(cfg)
+    model.eval()
+    return model
+
+
+FAMILIES = ["gpt2", "llama", "gpt_neox", "gptj", "opt", "bloom", "gpt_bigcode"]
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(0)
+
+
+def _convert(tmp_path, family):
+    hf_model = _tiny_hf_model(family)
+    path = str(tmp_path / family)
+    hf_model.save_pretrained(path, safe_serialization=True)
+    cfg = hf_interop.config_from_hf(path, dtype=jnp.float32)
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    params = hf_interop.load_params_from_hf(path, cfg, template)
+    return hf_model, cfg, model, params, path
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_logits_parity(tmp_path, family, rng):
+    hf_model, cfg, model, params, _ = _convert(tmp_path, family)
+
+    tokens = rng.integers(0, VOCAB, size=(2, SEQ))
+    # row 0: full; row 1: left-padded by 5
+    mask = np.ones((2, SEQ), dtype=np.int64)
+    mask[1, :5] = 0
+
+    kwargs = {}
+    if family in ("gpt2", "gpt_bigcode"):
+        # HF's plain forward uses arange positions regardless of padding;
+        # the reference trainer passes mask-aware position_ids explicitly
+        # (accelerate_ppo_trainer.py:176-180), which is what our model
+        # computes internally — supply the same to the oracle.
+        pos = np.clip(np.cumsum(mask, axis=-1) - 1, 0, None)
+        kwargs["position_ids"] = torch.tensor(pos)
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(tokens), attention_mask=torch.tensor(mask), **kwargs
+        ).logits.numpy()
+
+    logits, _, _ = model.apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32), jnp.asarray(mask, jnp.int32)
+    )
+    ours = np.asarray(logits, np.float32)
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(ours[valid], ref[valid], atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_export_round_trip(tmp_path, family, rng):
+    """params -> HF state dict -> params is the identity (and the exported
+    dict matches the original HF checkpoint key set)."""
+    hf_model, cfg, model, params, path = _convert(tmp_path, family)
+    sd = hf_interop.params_to_hf_state_dict(params, cfg)
+
+    orig = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    # HF save drops tied/duplicate leaves; every original key we exported
+    # must match numerically.
+    checked = 0
+    for k, v in orig.items():
+        if k in sd:
+            np.testing.assert_allclose(sd[k], v, atol=1e-6, err_msg=k)
+            checked += 1
+    assert checked >= len(sd) * 0.9  # near-total coverage of exported keys
+
+    assert cfg.hf_family == family
+    assert hf_interop.infer_family(cfg) == family
+
+
+def test_preset_coverage():
+    """Every family has at least one preset and they build."""
+    from trlx_tpu.models.transformer import PRESETS, config_from_preset
+
+    for name in ("neox-tiny", "gptj-tiny", "opt-tiny", "bloom-tiny", "bigcode-tiny"):
+        assert name in PRESETS
+        cfg = config_from_preset(name, vocab_size=64, dtype=jnp.float32)
+        model = CausalLMWithValueHead(cfg)
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+        logits, values, _ = model.apply({"params": params}, tokens, jnp.ones_like(tokens))
+        assert logits.shape == (1, 8, 64)
+        assert np.all(np.isfinite(np.asarray(logits)))

@@ -3,16 +3,25 @@ and convert torch state dicts into our Flax param pytrees (and back, for
 `save_pretrained` export).
 
 Parity: the reference's PreTrainedModelWrapper.from_pretrained /
-save_pretrained (trlx/models/modeling_base.py:44-374). Conversion runs on
-torch-cpu; this environment has no network egress, so only local
-directories / cached checkpoints work.
+save_pretrained (trlx/models/modeling_base.py:44-374) and its per-arch
+branch classes' weight layouts (trlx/models/modeling_ppo.py:502-1222,
+hf_get_branch_class :1598-1637). Conversion runs on torch-cpu; this
+environment has no network egress, so only local directories / cached
+checkpoints work.
 
-Supported HF architectures: GPT2LMHeadModel, LlamaForCausalLM.
+Supported HF architectures: GPT2LMHeadModel, LlamaForCausalLM,
+GPTNeoXForCausalLM (pythia), GPTJForCausalLM, OPTForCausalLM,
+BloomForCausalLM, GPTBigCodeForCausalLM.
+
+Rotary conventions: our kernel uses the half-split ("rotate_half") layout.
+GPT-J checkpoints use the interleaved ("rotate_every_two") layout, so their
+q/k projection columns are permuted within the rotary dims at load time
+(and inverse-permuted on export) — numerically exact, no runtime cost.
 """
 
 import json
 import os
-from typing import Dict
+from typing import Callable, Dict, Tuple
 
 import numpy as np
 
@@ -33,47 +42,113 @@ def _read_hf_config(path: str) -> Dict:
     return AutoConfig.from_pretrained(path).to_dict()
 
 
+def _family_of(hf: Dict) -> str:
+    arch = ((hf.get("architectures") or [""])[0] or "").lower()
+    mt = hf.get("model_type", "")
+    for fam, keys in (
+        ("gpt_bigcode", ("bigcode",)),
+        ("gpt_neox", ("neox",)),
+        ("gptj", ("gptj",)),
+        ("gpt2", ("gpt2",)),
+        ("llama", ("llama", "mistral")),
+        ("opt", ("optfor",)),
+        ("bloom", ("bloom",)),
+    ):
+        if any(k in arch for k in keys) or mt == fam:
+            return fam
+    raise ValueError(f"Unsupported HF architecture for conversion: {arch or mt}")
+
+
+# ---------------------------------------------------------------------------
+# Config conversion
+# ---------------------------------------------------------------------------
+
+
 def config_from_hf(path: str, **overrides) -> TransformerConfig:
     hf = _read_hf_config(path)
-    arch = (hf.get("architectures") or [hf.get("model_type", "")])[0]
-    if "gpt2" in arch.lower() or hf.get("model_type") == "gpt2":
+    fam = _family_of(hf)
+    if fam == "gpt2":
         kwargs = dict(
-            vocab_size=hf["vocab_size"],
-            d_model=hf["n_embd"],
-            n_layers=hf["n_layer"],
-            n_heads=hf["n_head"],
-            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
-            max_seq_len=hf["n_positions"],
-            pos_embed="learned",
-            norm="layernorm",
-            activation="gelu",
-            glu=False,
-            tie_embeddings=True,
-            use_bias=True,
+            vocab_size=hf["vocab_size"], d_model=hf["n_embd"], n_layers=hf["n_layer"],
+            n_heads=hf["n_head"], d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=hf["n_positions"], pos_embed="learned", norm="layernorm",
+            activation="gelu", glu=False, tie_embeddings=True, use_bias=True,
             layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
         )
-    elif "llama" in arch.lower() or hf.get("model_type") == "llama":
+    elif fam == "llama":
         kwargs = dict(
-            vocab_size=hf["vocab_size"],
-            d_model=hf["hidden_size"],
-            n_layers=hf["num_hidden_layers"],
-            n_heads=hf["num_attention_heads"],
-            n_kv_heads=hf.get("num_key_value_heads"),
-            d_ff=hf["intermediate_size"],
-            max_seq_len=hf.get("max_position_embeddings", 4096),
-            pos_embed="rope",
-            norm="rmsnorm",
-            activation="silu",
-            glu=True,
-            tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
-            use_bias=False,
+            vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
+            n_layers=hf["num_hidden_layers"], n_heads=hf["num_attention_heads"],
+            n_kv_heads=hf.get("num_key_value_heads"), d_ff=hf["intermediate_size"],
+            max_seq_len=hf.get("max_position_embeddings", 4096), pos_embed="rope",
+            norm="rmsnorm", activation="silu", glu=True,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)), use_bias=False,
             rope_theta=hf.get("rope_theta", 10000.0),
             layer_norm_epsilon=hf.get("rms_norm_eps", 1e-6),
         )
-    else:
-        raise ValueError(f"Unsupported HF architecture for conversion: {arch}")
+    elif fam == "gpt_neox":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
+            n_layers=hf["num_hidden_layers"], n_heads=hf["num_attention_heads"],
+            d_ff=hf["intermediate_size"], max_seq_len=hf["max_position_embeddings"],
+            pos_embed="rope", rotary_pct=hf.get("rotary_pct", 1.0),
+            rope_theta=hf.get("rotary_emb_base", 10000.0),
+            norm="layernorm", activation="gelu_exact" if hf.get("hidden_act", "gelu") == "gelu" else "gelu",
+            parallel_residual=bool(hf.get("use_parallel_residual", True)),
+            tie_embeddings=bool(hf.get("tie_word_embeddings", False)), use_bias=True,
+            layer_norm_epsilon=hf.get("layer_norm_eps", 1e-5),
+        )
+    elif fam == "gptj":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"], d_model=hf["n_embd"], n_layers=hf["n_layer"],
+            n_heads=hf["n_head"], d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=hf["n_positions"], pos_embed="rope",
+            rotary_pct=(hf.get("rotary_dim") or (hf["n_embd"] // hf["n_head"]))
+            / (hf["n_embd"] // hf["n_head"]),
+            norm="layernorm", activation="gelu",
+            parallel_residual=True, shared_ln=True,
+            tie_embeddings=False, attn_bias=False, lm_head_bias=True, use_bias=True,
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        )
+    elif fam == "opt":
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("OPT variants with do_layer_norm_before=False (350m) are unsupported")
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise ValueError("OPT word_embed_proj_dim != hidden_size is unsupported")
+        kwargs = dict(
+            vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
+            n_layers=hf["num_hidden_layers"], n_heads=hf["num_attention_heads"],
+            d_ff=hf["ffn_dim"], max_seq_len=hf["max_position_embeddings"],
+            pos_embed="learned", pos_offset=2, norm="layernorm",
+            activation="relu" if hf.get("activation_function", "relu") == "relu" else "gelu",
+            tie_embeddings=True, use_bias=True,
+            layer_norm_epsilon=1e-5,
+        )
+    elif fam == "bloom":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"], d_model=hf["hidden_size"],
+            n_layers=hf["n_layer"], n_heads=hf["n_head"], d_ff=4 * hf["hidden_size"],
+            max_seq_len=2048, pos_embed="none", alibi=True, embed_ln=True,
+            norm="layernorm", activation="gelu", tie_embeddings=True, use_bias=True,
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        )
+    elif fam == "gpt_bigcode":
+        kwargs = dict(
+            vocab_size=hf["vocab_size"], d_model=hf["n_embd"], n_layers=hf["n_layer"],
+            n_heads=hf["n_head"], n_kv_heads=1 if hf.get("multi_query", True) else None,
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"], max_seq_len=hf["n_positions"],
+            pos_embed="learned", norm="layernorm", activation="gelu",
+            tie_embeddings=True, use_bias=True,
+            layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
+        )
+    kwargs["hf_family"] = fam
     kwargs.update(overrides)
     return TransformerConfig(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# State-dict IO
+# ---------------------------------------------------------------------------
 
 
 def _load_state_dict(path: str) -> Dict[str, np.ndarray]:
@@ -110,121 +185,534 @@ def _load_state_dict(path: str) -> Dict[str, np.ndarray]:
     return tensors
 
 
+def _strip_prefix(sd: Dict[str, np.ndarray], *prefixes: str) -> Dict[str, np.ndarray]:
+    """Drop a leading wrapper prefix (e.g. 'transformer.', 'model.decoder.')
+    if every relevant key carries it."""
+    for p in prefixes:
+        if any(k.startswith(p) for k in sd):
+            return {k[len(p):] if k.startswith(p) else k: v for k, v in sd.items()}
+    return sd
+
+
+def _gptj_rope_perm(rd: int) -> np.ndarray:
+    """Permutation mapping interleaved rotary layout -> half-split layout:
+    target dim i reads source dim 2i (first half) / 2(i-rd/2)+1 (second)."""
+    half = rd // 2
+    return np.concatenate([np.arange(half) * 2, np.arange(half) * 2 + 1])
+
+
+def _permute_rotary_cols(w: np.ndarray, cfg: TransformerConfig, n_heads: int, inverse: bool = False):
+    """Permute a projection kernel's output dims ([in, heads*hd]) from the
+    interleaved to the half-split rotary convention (or back)."""
+    rd = cfg.rotary_dim
+    perm = _gptj_rope_perm(rd)
+    if inverse:
+        perm = np.argsort(perm)
+    hd = cfg.head_dim
+    w = w.reshape(w.shape[:-1] + (n_heads, hd)).copy()
+    w[..., :rd] = w[..., perm]
+    return w.reshape(w.shape[:-2] + (n_heads * hd,))
+
+
+def _split_fused_qkv_per_head(qkv: np.ndarray, n_heads: int, head_dim: int):
+    """Split a fused [in, heads*3*hd] kernel whose output is laid out
+    per-head as (q,k,v) triples (GPT-NeoX / Bloom) into separate q/k/v
+    kernels of [in, heads*hd]. Also accepts 1-D biases."""
+    shape = qkv.shape[:-1]
+    x = qkv.reshape(shape + (n_heads, 3, head_dim))
+    q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+    flat = shape + (n_heads * head_dim,)
+    return q.reshape(flat), k.reshape(flat), v.reshape(flat)
+
+
+# ---------------------------------------------------------------------------
+# Per-family load converters: HF state dict -> our "lm" subtree
+# ---------------------------------------------------------------------------
+
+
+def _ln(sd, prefix, bias=True):
+    out = {"scale": sd[prefix + ".weight"]}
+    if bias:
+        out["bias"] = sd[prefix + ".bias"]
+    return out
+
+
+def _dense(kernel, bias=None):
+    out = {"kernel": kernel}
+    if bias is not None:
+        out["bias"] = bias
+    return out
+
+
+def _load_gpt2(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "transformer.")
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["wte.weight"]},
+        "embed_pos": {"embedding": sd["wpe.weight"]},
+        "ln_f": _ln(sd, "ln_f"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        # GPT-2 fused qkv: c_attn.weight [d, 3d] (Conv1D layout: in x out)
+        qw, kw, vw = np.split(sd[p + "attn.c_attn.weight"], 3, axis=1)
+        qb, kb, vb = np.split(sd[p + "attn.c_attn.bias"], 3, axis=0)
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "ln_1"),
+            "ln_mlp": _ln(sd, p + "ln_2"),
+            "attn": {
+                "q_proj": _dense(qw, qb), "k_proj": _dense(kw, kb), "v_proj": _dense(vw, vb),
+                "o_proj": _dense(sd[p + "attn.c_proj.weight"], sd[p + "attn.c_proj.bias"]),
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "mlp.c_fc.weight"], sd[p + "mlp.c_fc.bias"]),
+                "down_proj": _dense(sd[p + "mlp.c_proj.weight"], sd[p + "mlp.c_proj.bias"]),
+            },
+        }
+    return lm
+
+
+def _load_llama(sd: Dict, cfg: TransformerConfig) -> Dict:
+    pre = "model." if any(k.startswith("model.") for k in sd) else ""
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd[f"{pre}embed_tokens.weight"]},
+        "ln_f": _ln(sd, f"{pre}norm", bias=False),
+    }
+    for i in range(cfg.n_layers):
+        p = f"{pre}layers.{i}."
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "input_layernorm", bias=False),
+            "ln_mlp": _ln(sd, p + "post_attention_layernorm", bias=False),
+            "attn": {
+                # HF stores [out, in]; our Dense kernels are [in, out]
+                n: _dense(sd[p + f"self_attn.{n}.weight"].T)
+                for n in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "mlp": {
+                n: _dense(sd[p + f"mlp.{n}.weight"].T)
+                for n in ("gate_proj", "up_proj", "down_proj")
+            },
+        }
+    if not cfg.tie_embeddings:
+        lm["lm_head"] = _dense(sd["lm_head.weight"].T)
+    return lm
+
+
+def _load_gpt_neox(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "gpt_neox.")
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["embed_in.weight"]},
+        "ln_f": _ln(sd, "final_layer_norm"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        qw, kw, vw = _split_fused_qkv_per_head(
+            sd[p + "attention.query_key_value.weight"].T, cfg.n_heads, cfg.head_dim
+        )
+        qb, kb, vb = _split_fused_qkv_per_head(
+            sd[p + "attention.query_key_value.bias"], cfg.n_heads, cfg.head_dim
+        )
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "input_layernorm"),
+            "ln_mlp": _ln(sd, p + "post_attention_layernorm"),
+            "attn": {
+                "q_proj": _dense(qw, qb), "k_proj": _dense(kw, kb), "v_proj": _dense(vw, vb),
+                "o_proj": _dense(sd[p + "attention.dense.weight"].T, sd[p + "attention.dense.bias"]),
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "mlp.dense_h_to_4h.weight"].T, sd[p + "mlp.dense_h_to_4h.bias"]),
+                "down_proj": _dense(sd[p + "mlp.dense_4h_to_h.weight"].T, sd[p + "mlp.dense_4h_to_h.bias"]),
+            },
+        }
+    lm["lm_head"] = _dense(sd["embed_out.weight"].T)
+    return lm
+
+
+def _load_gptj(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "transformer.")
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["wte.weight"]},
+        "ln_f": _ln(sd, "ln_f"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        qw = _permute_rotary_cols(sd[p + "attn.q_proj.weight"].T, cfg, cfg.n_heads)
+        kw = _permute_rotary_cols(sd[p + "attn.k_proj.weight"].T, cfg, cfg.kv_heads)
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "ln_1"),
+            "attn": {
+                "q_proj": _dense(qw), "k_proj": _dense(kw),
+                "v_proj": _dense(sd[p + "attn.v_proj.weight"].T),
+                "o_proj": _dense(sd[p + "attn.out_proj.weight"].T),
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "mlp.fc_in.weight"].T, sd[p + "mlp.fc_in.bias"]),
+                "down_proj": _dense(sd[p + "mlp.fc_out.weight"].T, sd[p + "mlp.fc_out.bias"]),
+            },
+        }
+    lm["lm_head"] = _dense(sd["lm_head.weight"].T, sd["lm_head.bias"])
+    return lm
+
+
+def _load_opt(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "model.decoder.", "decoder.")
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["embed_tokens.weight"]},
+        "embed_pos": {"embedding": sd["embed_positions.weight"]},
+        "ln_f": _ln(sd, "final_layer_norm"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "self_attn_layer_norm"),
+            "ln_mlp": _ln(sd, p + "final_layer_norm"),
+            "attn": {
+                our: _dense(sd[p + f"self_attn.{hf}.weight"].T, sd[p + f"self_attn.{hf}.bias"])
+                for our, hf in (
+                    ("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                    ("v_proj", "v_proj"), ("o_proj", "out_proj"),
+                )
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "fc1.weight"].T, sd[p + "fc1.bias"]),
+                "down_proj": _dense(sd[p + "fc2.weight"].T, sd[p + "fc2.bias"]),
+            },
+        }
+    return lm
+
+
+def _load_bloom(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "transformer.")
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["word_embeddings.weight"]},
+        "ln_embed": _ln(sd, "word_embeddings_layernorm"),
+        "ln_f": _ln(sd, "ln_f"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        qw, kw, vw = _split_fused_qkv_per_head(
+            sd[p + "self_attention.query_key_value.weight"].T, cfg.n_heads, cfg.head_dim
+        )
+        qb, kb, vb = _split_fused_qkv_per_head(
+            sd[p + "self_attention.query_key_value.bias"], cfg.n_heads, cfg.head_dim
+        )
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "input_layernorm"),
+            "ln_mlp": _ln(sd, p + "post_attention_layernorm"),
+            "attn": {
+                "q_proj": _dense(qw, qb), "k_proj": _dense(kw, kb), "v_proj": _dense(vw, vb),
+                "o_proj": _dense(sd[p + "self_attention.dense.weight"].T, sd[p + "self_attention.dense.bias"]),
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "mlp.dense_h_to_4h.weight"].T, sd[p + "mlp.dense_h_to_4h.bias"]),
+                "down_proj": _dense(sd[p + "mlp.dense_4h_to_h.weight"].T, sd[p + "mlp.dense_4h_to_h.bias"]),
+            },
+        }
+    return lm
+
+
+def _load_gpt_bigcode(sd: Dict, cfg: TransformerConfig) -> Dict:
+    sd = _strip_prefix(sd, "transformer.")
+    d, kv_dim = cfg.d_model, cfg.kv_heads * cfg.head_dim
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["wte.weight"]},
+        "embed_pos": {"embedding": sd["wpe.weight"]},
+        "ln_f": _ln(sd, "ln_f"),
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        # torch Linear layout [out, in]; fused output = [q(d), k(kv), v(kv)]
+        w = sd[p + "attn.c_attn.weight"].T
+        b = sd[p + "attn.c_attn.bias"]
+        qw, kw, vw = w[:, :d], w[:, d:d + kv_dim], w[:, d + kv_dim:]
+        qb, kb, vb = b[:d], b[d:d + kv_dim], b[d + kv_dim:]
+        lm[f"block_{i}"] = {
+            "ln_attn": _ln(sd, p + "ln_1"),
+            "ln_mlp": _ln(sd, p + "ln_2"),
+            "attn": {
+                "q_proj": _dense(qw, qb), "k_proj": _dense(kw, kb), "v_proj": _dense(vw, vb),
+                "o_proj": _dense(sd[p + "attn.c_proj.weight"].T, sd[p + "attn.c_proj.bias"]),
+            },
+            "mlp": {
+                "up_proj": _dense(sd[p + "mlp.c_fc.weight"].T, sd[p + "mlp.c_fc.bias"]),
+                "down_proj": _dense(sd[p + "mlp.c_proj.weight"].T, sd[p + "mlp.c_proj.bias"]),
+            },
+        }
+    return lm
+
+
+_LOADERS: Dict[str, Callable] = {
+    "gpt2": _load_gpt2,
+    "llama": _load_llama,
+    "gpt_neox": _load_gpt_neox,
+    "gptj": _load_gptj,
+    "opt": _load_opt,
+    "bloom": _load_bloom,
+    "gpt_bigcode": _load_gpt_bigcode,
+}
+
+
 def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict) -> Dict:
     """Convert an HF state dict into our param pytree, using the template's
-    structure/dtypes. Keys follow the GPT2/Llama HF layouts."""
+    structure/dtypes."""
+    hf = _read_hf_config(path)
+    fam = _family_of(hf)
     sd = _load_state_dict(path)
-    is_gpt2 = any(k.startswith(("wte.", "transformer.wte.", "h.", "transformer.h.")) for k in sd)
-    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
-    lm: Dict = {}
-
-    def dt(template_leaf, arr):
-        return np.asarray(arr, dtype=np.dtype(template_leaf.dtype))
-
-    tpl_lm = params_template["lm"]
-    if is_gpt2:
-        lm["embed_tokens"] = {"embedding": sd[f"{prefix}wte.weight"]}
-        lm["embed_pos"] = {"embedding": sd[f"{prefix}wpe.weight"]}
-        for i in range(cfg.n_layers):
-            p = f"{prefix}h.{i}."
-            # GPT-2 fused qkv: c_attn.weight [d, 3d] (Conv1D layout: in x out)
-            qkv_w = sd[p + "attn.c_attn.weight"]
-            qkv_b = sd[p + "attn.c_attn.bias"]
-            qw, kw, vw = np.split(qkv_w, 3, axis=1)
-            qb, kb, vb = np.split(qkv_b, 3, axis=0)
-            lm[f"block_{i}"] = {
-                "ln_attn": {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
-                "ln_mlp": {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
-                "attn": {
-                    "q_proj": {"kernel": qw, "bias": qb},
-                    "k_proj": {"kernel": kw, "bias": kb},
-                    "v_proj": {"kernel": vw, "bias": vb},
-                    "o_proj": {"kernel": sd[p + "attn.c_proj.weight"], "bias": sd[p + "attn.c_proj.bias"]},
-                },
-                "mlp": {
-                    "up_proj": {"kernel": sd[p + "mlp.c_fc.weight"], "bias": sd[p + "mlp.c_fc.bias"]},
-                    "down_proj": {"kernel": sd[p + "mlp.c_proj.weight"], "bias": sd[p + "mlp.c_proj.bias"]},
-                },
-            }
-        lm["ln_f"] = {"scale": sd[f"{prefix}ln_f.weight"], "bias": sd[f"{prefix}ln_f.bias"]}
-    else:  # llama
-        pre = "model." if any(k.startswith("model.") for k in sd) else ""
-        lm["embed_tokens"] = {"embedding": sd[f"{pre}embed_tokens.weight"]}
-        for i in range(cfg.n_layers):
-            p = f"{pre}layers.{i}."
-            lm[f"block_{i}"] = {
-                "ln_attn": {"scale": sd[p + "input_layernorm.weight"]},
-                "ln_mlp": {"scale": sd[p + "post_attention_layernorm.weight"]},
-                "attn": {
-                    # HF stores [out, in]; our Dense kernels are [in, out]
-                    "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T},
-                    "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T},
-                    "v_proj": {"kernel": sd[p + "self_attn.v_proj.weight"].T},
-                    "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T},
-                },
-                "mlp": {
-                    "gate_proj": {"kernel": sd[p + "mlp.gate_proj.weight"].T},
-                    "up_proj": {"kernel": sd[p + "mlp.up_proj.weight"].T},
-                    "down_proj": {"kernel": sd[p + "mlp.down_proj.weight"].T},
-                },
-            }
-        lm["ln_f"] = {"scale": sd[f"{pre}norm.weight"]}
-        if not cfg.tie_embeddings:
-            lm["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    lm = _LOADERS[fam](sd, cfg)
 
     import jax
 
+    def dt(template_leaf, arr):
+        a = np.asarray(arr, dtype=np.dtype(template_leaf.dtype))
+        if a.shape != template_leaf.shape:
+            raise ValueError(
+                f"Converted weight shape {a.shape} != expected {template_leaf.shape}"
+            )
+        return a
+
     new_params = dict(params_template)
-    new_params["lm"] = jax.tree_util.tree_map(dt, tpl_lm, lm)
-    logger.info(f"Loaded HF weights from {path}")
+    new_params["lm"] = jax.tree_util.tree_map(dt, params_template["lm"], lm)
+    logger.info(f"Loaded HF weights ({fam}) from {path}")
     return new_params
 
 
-def params_to_hf_state_dict(params: Dict, cfg: TransformerConfig) -> Dict:
-    """Export our LM params back to an HF-layout state dict (GPT-2/Llama),
-    for `save_pretrained` interop."""
-    lm = params["lm"]
-    sd: Dict[str, np.ndarray] = {}
-    gpt2 = cfg.pos_embed == "learned"
-    if gpt2:
-        sd["transformer.wte.weight"] = np.asarray(lm["embed_tokens"]["embedding"], np.float32)
-        sd["transformer.wpe.weight"] = np.asarray(lm["embed_pos"]["embedding"], np.float32)
-        for i in range(cfg.n_layers):
-            b = lm[f"block_{i}"]
-            p = f"transformer.h.{i}."
-            sd[p + "ln_1.weight"] = np.asarray(b["ln_attn"]["scale"], np.float32)
-            sd[p + "ln_1.bias"] = np.asarray(b["ln_attn"]["bias"], np.float32)
-            sd[p + "ln_2.weight"] = np.asarray(b["ln_mlp"]["scale"], np.float32)
-            sd[p + "ln_2.bias"] = np.asarray(b["ln_mlp"]["bias"], np.float32)
-            sd[p + "attn.c_attn.weight"] = np.concatenate(
-                [np.asarray(b["attn"][n]["kernel"], np.float32) for n in ("q_proj", "k_proj", "v_proj")], axis=1
-            )
-            sd[p + "attn.c_attn.bias"] = np.concatenate(
-                [np.asarray(b["attn"][n]["bias"], np.float32) for n in ("q_proj", "k_proj", "v_proj")], axis=0
-            )
-            sd[p + "attn.c_proj.weight"] = np.asarray(b["attn"]["o_proj"]["kernel"], np.float32)
-            sd[p + "attn.c_proj.bias"] = np.asarray(b["attn"]["o_proj"]["bias"], np.float32)
-            sd[p + "mlp.c_fc.weight"] = np.asarray(b["mlp"]["up_proj"]["kernel"], np.float32)
-            sd[p + "mlp.c_fc.bias"] = np.asarray(b["mlp"]["up_proj"]["bias"], np.float32)
-            sd[p + "mlp.c_proj.weight"] = np.asarray(b["mlp"]["down_proj"]["kernel"], np.float32)
-            sd[p + "mlp.c_proj.bias"] = np.asarray(b["mlp"]["down_proj"]["bias"], np.float32)
-        sd["transformer.ln_f.weight"] = np.asarray(lm["ln_f"]["scale"], np.float32)
-        sd["transformer.ln_f.bias"] = np.asarray(lm["ln_f"]["bias"], np.float32)
-        sd["lm_head.weight"] = sd["transformer.wte.weight"]
-    else:
-        sd["model.embed_tokens.weight"] = np.asarray(lm["embed_tokens"]["embedding"], np.float32)
-        for i in range(cfg.n_layers):
-            b = lm[f"block_{i}"]
-            p = f"model.layers.{i}."
-            sd[p + "input_layernorm.weight"] = np.asarray(b["ln_attn"]["scale"], np.float32)
-            sd[p + "post_attention_layernorm.weight"] = np.asarray(b["ln_mlp"]["scale"], np.float32)
-            for n in ("q_proj", "k_proj", "v_proj", "o_proj"):
-                sd[p + f"self_attn.{n}.weight"] = np.asarray(b["attn"][n]["kernel"], np.float32).T
-            for n in ("gate_proj", "up_proj", "down_proj"):
-                sd[p + f"mlp.{n}.weight"] = np.asarray(b["mlp"][n]["kernel"], np.float32).T
-        sd["model.norm.weight"] = np.asarray(lm["ln_f"]["scale"], np.float32)
-        if "lm_head" in lm:
-            sd["lm_head.weight"] = np.asarray(lm["lm_head"]["kernel"], np.float32).T
-        else:
-            sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+# ---------------------------------------------------------------------------
+# Export: our params -> HF-layout state dict (save_pretrained interop)
+# ---------------------------------------------------------------------------
+
+
+def _f32(x):
+    return np.asarray(x, np.float32)
+
+
+def _export_gpt2(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "transformer.wte.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "transformer.wpe.weight": _f32(lm["embed_pos"]["embedding"]),
+        "transformer.ln_f.weight": _f32(lm["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(lm["ln_f"]["bias"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "ln_1.bias"] = _f32(b["ln_attn"]["bias"])
+        sd[p + "ln_2.weight"] = _f32(b["ln_mlp"]["scale"])
+        sd[p + "ln_2.bias"] = _f32(b["ln_mlp"]["bias"])
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [_f32(b["attn"][n]["kernel"]) for n in ("q_proj", "k_proj", "v_proj")], axis=1
+        )
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [_f32(b["attn"][n]["bias"]) for n in ("q_proj", "k_proj", "v_proj")], axis=0
+        )
+        sd[p + "attn.c_proj.weight"] = _f32(b["attn"]["o_proj"]["kernel"])
+        sd[p + "attn.c_proj.bias"] = _f32(b["attn"]["o_proj"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = _f32(b["mlp"]["up_proj"]["kernel"])
+        sd[p + "mlp.c_fc.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = _f32(b["mlp"]["down_proj"]["kernel"])
+        sd[p + "mlp.c_proj.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
     return sd
+
+
+def _export_llama(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "model.embed_tokens.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "model.norm.weight": _f32(lm["ln_f"]["scale"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "post_attention_layernorm.weight"] = _f32(b["ln_mlp"]["scale"])
+        for n in ("q_proj", "k_proj", "v_proj"):
+            sd[p + f"self_attn.{n}.weight"] = _f32(b["attn"][n]["kernel"]).T
+        sd[p + "self_attn.o_proj.weight"] = _f32(b["attn"]["o_proj"]["kernel"]).T
+        for n in ("gate_proj", "up_proj", "down_proj"):
+            sd[p + f"mlp.{n}.weight"] = _f32(b["mlp"][n]["kernel"]).T
+    if "lm_head" in lm:
+        sd["lm_head.weight"] = _f32(lm["lm_head"]["kernel"]).T
+    else:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    return sd
+
+
+def _fuse_qkv_per_head(q, k, v, n_heads, head_dim):
+    """Inverse of _split_fused_qkv_per_head."""
+    shape = q.shape[:-1]
+    stack = np.stack(
+        [x.reshape(shape + (n_heads, head_dim)) for x in (q, k, v)], axis=-2
+    )  # [..., heads, 3, hd]
+    return stack.reshape(shape + (n_heads * 3 * head_dim,))
+
+
+def _export_gpt_neox(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "gpt_neox.embed_in.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "gpt_neox.final_layer_norm.weight": _f32(lm["ln_f"]["scale"]),
+        "gpt_neox.final_layer_norm.bias": _f32(lm["ln_f"]["bias"]),
+        "embed_out.weight": _f32(lm["lm_head"]["kernel"]).T,
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"gpt_neox.layers.{i}."
+        sd[p + "input_layernorm.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "input_layernorm.bias"] = _f32(b["ln_attn"]["bias"])
+        sd[p + "post_attention_layernorm.weight"] = _f32(b["ln_mlp"]["scale"])
+        sd[p + "post_attention_layernorm.bias"] = _f32(b["ln_mlp"]["bias"])
+        sd[p + "attention.query_key_value.weight"] = _fuse_qkv_per_head(
+            *( _f32(b["attn"][n]["kernel"]) for n in ("q_proj", "k_proj", "v_proj")),
+            cfg.n_heads, cfg.head_dim,
+        ).T
+        sd[p + "attention.query_key_value.bias"] = _fuse_qkv_per_head(
+            *( _f32(b["attn"][n]["bias"]) for n in ("q_proj", "k_proj", "v_proj")),
+            cfg.n_heads, cfg.head_dim,
+        )
+        sd[p + "attention.dense.weight"] = _f32(b["attn"]["o_proj"]["kernel"]).T
+        sd[p + "attention.dense.bias"] = _f32(b["attn"]["o_proj"]["bias"])
+        sd[p + "mlp.dense_h_to_4h.weight"] = _f32(b["mlp"]["up_proj"]["kernel"]).T
+        sd[p + "mlp.dense_h_to_4h.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "mlp.dense_4h_to_h.weight"] = _f32(b["mlp"]["down_proj"]["kernel"]).T
+        sd[p + "mlp.dense_4h_to_h.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    return sd
+
+
+def _export_gptj(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "transformer.wte.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "transformer.ln_f.weight": _f32(lm["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(lm["ln_f"]["bias"]),
+        "lm_head.weight": _f32(lm["lm_head"]["kernel"]).T,
+        "lm_head.bias": _f32(lm["lm_head"]["bias"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "ln_1.bias"] = _f32(b["ln_attn"]["bias"])
+        qw = _permute_rotary_cols(_f32(b["attn"]["q_proj"]["kernel"]), cfg, cfg.n_heads, inverse=True)
+        kw = _permute_rotary_cols(_f32(b["attn"]["k_proj"]["kernel"]), cfg, cfg.kv_heads, inverse=True)
+        sd[p + "attn.q_proj.weight"] = qw.T
+        sd[p + "attn.k_proj.weight"] = kw.T
+        sd[p + "attn.v_proj.weight"] = _f32(b["attn"]["v_proj"]["kernel"]).T
+        sd[p + "attn.out_proj.weight"] = _f32(b["attn"]["o_proj"]["kernel"]).T
+        sd[p + "mlp.fc_in.weight"] = _f32(b["mlp"]["up_proj"]["kernel"]).T
+        sd[p + "mlp.fc_in.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "mlp.fc_out.weight"] = _f32(b["mlp"]["down_proj"]["kernel"]).T
+        sd[p + "mlp.fc_out.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    return sd
+
+
+def _export_opt(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "model.decoder.embed_tokens.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "model.decoder.embed_positions.weight": _f32(lm["embed_pos"]["embedding"]),
+        "model.decoder.final_layer_norm.weight": _f32(lm["ln_f"]["scale"]),
+        "model.decoder.final_layer_norm.bias": _f32(lm["ln_f"]["bias"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"model.decoder.layers.{i}."
+        sd[p + "self_attn_layer_norm.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "self_attn_layer_norm.bias"] = _f32(b["ln_attn"]["bias"])
+        sd[p + "final_layer_norm.weight"] = _f32(b["ln_mlp"]["scale"])
+        sd[p + "final_layer_norm.bias"] = _f32(b["ln_mlp"]["bias"])
+        for our, hf in (("q_proj", "q_proj"), ("k_proj", "k_proj"),
+                        ("v_proj", "v_proj"), ("o_proj", "out_proj")):
+            sd[p + f"self_attn.{hf}.weight"] = _f32(b["attn"][our]["kernel"]).T
+            sd[p + f"self_attn.{hf}.bias"] = _f32(b["attn"][our]["bias"])
+        sd[p + "fc1.weight"] = _f32(b["mlp"]["up_proj"]["kernel"]).T
+        sd[p + "fc1.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "fc2.weight"] = _f32(b["mlp"]["down_proj"]["kernel"]).T
+        sd[p + "fc2.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    sd["lm_head.weight"] = sd["model.decoder.embed_tokens.weight"]
+    return sd
+
+
+def _export_bloom(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "transformer.word_embeddings.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "transformer.word_embeddings_layernorm.weight": _f32(lm["ln_embed"]["scale"]),
+        "transformer.word_embeddings_layernorm.bias": _f32(lm["ln_embed"]["bias"]),
+        "transformer.ln_f.weight": _f32(lm["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(lm["ln_f"]["bias"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"transformer.h.{i}."
+        sd[p + "input_layernorm.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "input_layernorm.bias"] = _f32(b["ln_attn"]["bias"])
+        sd[p + "post_attention_layernorm.weight"] = _f32(b["ln_mlp"]["scale"])
+        sd[p + "post_attention_layernorm.bias"] = _f32(b["ln_mlp"]["bias"])
+        sd[p + "self_attention.query_key_value.weight"] = _fuse_qkv_per_head(
+            *( _f32(b["attn"][n]["kernel"]) for n in ("q_proj", "k_proj", "v_proj")),
+            cfg.n_heads, cfg.head_dim,
+        ).T
+        sd[p + "self_attention.query_key_value.bias"] = _fuse_qkv_per_head(
+            *( _f32(b["attn"][n]["bias"]) for n in ("q_proj", "k_proj", "v_proj")),
+            cfg.n_heads, cfg.head_dim,
+        )
+        sd[p + "self_attention.dense.weight"] = _f32(b["attn"]["o_proj"]["kernel"]).T
+        sd[p + "self_attention.dense.bias"] = _f32(b["attn"]["o_proj"]["bias"])
+        sd[p + "mlp.dense_h_to_4h.weight"] = _f32(b["mlp"]["up_proj"]["kernel"]).T
+        sd[p + "mlp.dense_h_to_4h.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "mlp.dense_4h_to_h.weight"] = _f32(b["mlp"]["down_proj"]["kernel"]).T
+        sd[p + "mlp.dense_4h_to_h.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    sd["lm_head.weight"] = sd["transformer.word_embeddings.weight"]
+    return sd
+
+
+def _export_gpt_bigcode(lm: Dict, cfg: TransformerConfig) -> Dict:
+    sd = {
+        "transformer.wte.weight": _f32(lm["embed_tokens"]["embedding"]),
+        "transformer.wpe.weight": _f32(lm["embed_pos"]["embedding"]),
+        "transformer.ln_f.weight": _f32(lm["ln_f"]["scale"]),
+        "transformer.ln_f.bias": _f32(lm["ln_f"]["bias"]),
+    }
+    for i in range(cfg.n_layers):
+        b, p = lm[f"block_{i}"], f"transformer.h.{i}."
+        sd[p + "ln_1.weight"] = _f32(b["ln_attn"]["scale"])
+        sd[p + "ln_1.bias"] = _f32(b["ln_attn"]["bias"])
+        sd[p + "ln_2.weight"] = _f32(b["ln_mlp"]["scale"])
+        sd[p + "ln_2.bias"] = _f32(b["ln_mlp"]["bias"])
+        sd[p + "attn.c_attn.weight"] = np.concatenate(
+            [_f32(b["attn"][n]["kernel"]) for n in ("q_proj", "k_proj", "v_proj")], axis=1
+        ).T
+        sd[p + "attn.c_attn.bias"] = np.concatenate(
+            [_f32(b["attn"][n]["bias"]) for n in ("q_proj", "k_proj", "v_proj")], axis=0
+        )
+        sd[p + "attn.c_proj.weight"] = _f32(b["attn"]["o_proj"]["kernel"]).T
+        sd[p + "attn.c_proj.bias"] = _f32(b["attn"]["o_proj"]["bias"])
+        sd[p + "mlp.c_fc.weight"] = _f32(b["mlp"]["up_proj"]["kernel"]).T
+        sd[p + "mlp.c_fc.bias"] = _f32(b["mlp"]["up_proj"]["bias"])
+        sd[p + "mlp.c_proj.weight"] = _f32(b["mlp"]["down_proj"]["kernel"]).T
+        sd[p + "mlp.c_proj.bias"] = _f32(b["mlp"]["down_proj"]["bias"])
+    sd["lm_head.weight"] = sd["transformer.wte.weight"]
+    return sd
+
+
+_EXPORTERS: Dict[str, Callable] = {
+    "gpt2": _export_gpt2,
+    "llama": _export_llama,
+    "gpt_neox": _export_gpt_neox,
+    "gptj": _export_gptj,
+    "opt": _export_opt,
+    "bloom": _export_bloom,
+    "gpt_bigcode": _export_gpt_bigcode,
+}
+
+
+def infer_family(cfg: TransformerConfig) -> str:
+    """Best-effort family inference from a TransformerConfig's structure
+    (used when exporting a model that wasn't loaded from an HF dir)."""
+    if cfg.alibi:
+        return "bloom"
+    if cfg.pos_offset:
+        return "opt"
+    if cfg.parallel_residual:
+        return "gptj" if cfg.shared_ln else "gpt_neox"
+    if cfg.pos_embed == "rope":
+        return "llama"
+    if (cfg.n_kv_heads or cfg.n_heads) != cfg.n_heads:
+        return "gpt_bigcode"
+    return "gpt2"
+
+
+def params_to_hf_state_dict(params: Dict, cfg: TransformerConfig, family: str = None) -> Dict:
+    """Export our LM params back to an HF-layout state dict for
+    `save_pretrained` interop."""
+    family = family or cfg.hf_family or infer_family(cfg)
+    return _EXPORTERS[family](params["lm"], cfg)

@@ -140,8 +140,9 @@ def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: in
         name = parts[1]
         if name.startswith("block_"):
             return int(name.split("_")[1]) >= split
-        # embed_tokens / embed_pos / lm_head / ln_f
-        return name == "ln_f"
+        # Reference freeze_bottom_causal_layers freezes embeddings + bottom
+        # blocks only; final norm and an untied lm_head stay trainable.
+        return name in ("ln_f", "lm_head")
 
     return jax.tree_util.tree_map_with_path(_mask, params)
 

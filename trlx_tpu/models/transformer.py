@@ -36,12 +36,26 @@ class TransformerConfig:
     max_seq_len: int = 2048
     pos_embed: str = "learned"  # "learned" | "rope" | "none"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
-    activation: str = "gelu"  # "gelu" | "silu" (silu => swiglu MLP)
+    activation: str = "gelu"  # "gelu" (tanh approx) | "gelu_exact" | "silu" | "relu"
     glu: bool = False  # gated MLP (llama-style)
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     layer_norm_epsilon: float = 1e-5
     use_bias: bool = True  # dense biases (gpt2 yes, llama no)
+    # Per-family structure knobs covering the reference's per-arch branch
+    # classes (modeling_ppo.py:502-1222) in one parameterized module:
+    parallel_residual: bool = False  # h + attn(ln(h)) + mlp(·) (GPT-NeoX/GPT-J)
+    shared_ln: bool = False  # parallel-residual MLP reads ln_attn's output (GPT-J)
+    rotary_pct: float = 1.0  # fraction of head_dim that rotates (pythia 0.25, GPT-J 64/hd)
+    alibi: bool = False  # ALiBi key-position bias instead of position embeddings (Bloom)
+    pos_offset: int = 0  # learned-position lookup offset (OPT uses 2)
+    embed_ln: bool = False  # LayerNorm right after token embedding (Bloom)
+    attn_bias: Optional[bool] = None  # q/k/v/o bias override; None = use_bias (GPT-J: False)
+    lm_head_bias: bool = False  # untied lm_head carries a bias (GPT-J)
+    # HF family tag recorded at conversion time so save_pretrained exports
+    # the exact source layout (structure-based inference is ambiguous, e.g.
+    # non-MQA GPTBigCode vs GPT-2); None = infer from structure.
+    hf_family: Optional[str] = None
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
@@ -58,6 +72,11 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
 
+    @property
+    def rotary_dim(self) -> int:
+        rd = int(self.head_dim * self.rotary_pct)
+        return rd - (rd % 2)
+
 
 def make_norm(cfg: TransformerConfig, name: str):
     if cfg.norm == "rmsnorm":
@@ -69,16 +88,52 @@ def rope_frequencies(head_dim: int, theta: float) -> np.ndarray:
     return 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
 
 
-def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """Rotary position embedding. x: [b, t, h, hd], positions: [b, t]."""
+def apply_rope(
+    x: jnp.ndarray, positions: jnp.ndarray, theta: float, rotary_dim: Optional[int] = None
+) -> jnp.ndarray:
+    """Rotary position embedding (half-split / rotate_half convention).
+    x: [b, t, h, hd], positions: [b, t]. When rotary_dim < hd only the
+    first rotary_dim dims rotate (pythia/GPT-J partial rotary); interleaved
+    checkpoints (GPT-J) are converted to this layout at load time."""
     hd = x.shape[-1]
-    freqs = jnp.asarray(rope_frequencies(hd, theta))  # [hd/2]
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, t, hd/2]
-    cos = jnp.cos(angles)[:, :, None, :]  # [b, t, 1, hd/2]
+    rd = hd if rotary_dim is None else rotary_dim
+    rot, rest = (x, None) if rd == hd else (x[..., :rd], x[..., rd:])
+    freqs = jnp.asarray(rope_frequencies(rd, theta))  # [rd/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, t, rd/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [b, t, 1, rd/2]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    x1, x2 = jnp.split(rot.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return rotated.astype(x.dtype)
+    rotated = rotated.astype(x.dtype)
+    if rest is not None:
+        rotated = jnp.concatenate([rotated, rest], axis=-1)
+    return rotated
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (Press et al.; matches HF Bloom)."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return np.asarray(pow2(n_heads), dtype=np.float32)
+    closest = 2 ** math.floor(math.log2(n_heads))
+    extra = pow2(2 * closest)[0::2][: n_heads - closest]
+    return np.asarray(pow2(closest) + extra, dtype=np.float32)
+
+
+def alibi_bias(key_mask: jnp.ndarray, n_heads: int) -> jnp.ndarray:
+    """Additive ALiBi bias [b, h, 1, S] from key validity mask [b, S].
+    Uses the key-position form slope·k_pos (softmax-equivalent to the
+    relative form since the per-query constant cancels), exactly as HF
+    Bloom builds it from the attention-mask cumsum."""
+    k_pos = jnp.clip(jnp.cumsum(key_mask.astype(jnp.float32), axis=-1) - 1.0, 0.0, None)
+    k_pos = k_pos * key_mask.astype(jnp.float32)
+    slopes = jnp.asarray(alibi_slopes(n_heads))  # [h]
+    return (slopes[None, :, None, None] * k_pos[:, None, None, :]).astype(jnp.float32)
 
 
 class Attention(nn.Module):
@@ -97,16 +152,17 @@ class Attention(nn.Module):
         cfg = self.cfg
         b, t, d = h.shape
         nh, nkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        bias_flag = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
         dense = lambda feats, name: nn.Dense(
-            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
+            feats, use_bias=bias_flag, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
         )
         q = dense(nh * hd, "q_proj")(h).reshape(b, t, nh, hd)
         k = dense(nkv * hd, "k_proj")(h).reshape(b, t, nkv, hd)
         v = dense(nkv * hd, "v_proj")(h).reshape(b, t, nkv, hd)
 
         if cfg.pos_embed == "rope":
-            q = apply_rope(q, positions, cfg.rope_theta)
-            k = apply_rope(k, positions, cfg.rope_theta)
+            q = apply_rope(q, positions, cfg.rope_theta, cfg.rotary_dim)
+            k = apply_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
 
         new_cache = None
         if layer_cache is not None:
@@ -117,7 +173,7 @@ class Attention(nn.Module):
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv}
 
-        if cfg.attn_impl in ("flash", "ring") and layer_cache is None and attn_mask is not None:
+        if cfg.attn_impl in ("flash", "ring") and not cfg.alibi and layer_cache is None and attn_mask is not None:
             # Fused training/scoring path: causal + key-padding structure is
             # computed inside the kernel from `attn_mask`; `attn_bias` is
             # ignored (it encodes exactly that structure, causal_bias below).
@@ -157,7 +213,11 @@ class MLP(nn.Module):
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
         )
-        act = jax.nn.silu if cfg.activation == "silu" else jax.nn.gelu
+        act = {
+            "silu": jax.nn.silu,
+            "relu": jax.nn.relu,
+            "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+        }.get(cfg.activation, jax.nn.gelu)
         if cfg.glu:
             gated = act(dense(cfg.d_ff, "gate_proj")(h)) * dense(cfg.d_ff, "up_proj")(h)
             return dense(cfg.d_model, "down_proj")(gated)
@@ -170,11 +230,17 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None, attn_mask=None):
         cfg = self.cfg
+        h_ln = make_norm(cfg, "ln_attn")(h)
         attn_out, new_cache = Attention(cfg, name="attn")(
-            make_norm(cfg, "ln_attn")(h), attn_bias, positions, layer_cache, cache_index, attn_mask
+            h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask
         )
-        h = h + attn_out
-        h = h + MLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
+        if cfg.parallel_residual:
+            # GPT-NeoX: x + attn(ln1(x)) + mlp(ln2(x)); GPT-J shares ln1.
+            mlp_in = h_ln if cfg.shared_ln else make_norm(cfg, "ln_mlp")(h)
+            h = h + attn_out + MLP(cfg, name="mlp")(mlp_in)
+        else:
+            h = h + attn_out
+            h = h + MLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
         return h, new_cache
 
 
@@ -210,19 +276,25 @@ class TransformerLM(nn.Module):
         )
         if cfg.pos_embed == "learned":
             self.embed_pos = nn.Embed(
-                cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed_pos"
+                cfg.max_seq_len + cfg.pos_offset, cfg.d_model,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="embed_pos"
             )
+        if cfg.embed_ln:
+            self.ln_embed = make_norm(cfg, "ln_embed")
         self.blocks = [Block(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
         self.ln_f = make_norm(cfg, "ln_f")
         if not cfg.tie_embeddings:
             self.lm_head = nn.Dense(
-                cfg.vocab_size, use_bias=False, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
+                cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype, name="lm_head"
             )
 
     def embed(self, tokens, positions):
         h = self.embed_tokens(tokens)
         if self.cfg.pos_embed == "learned":
-            h = h + self.embed_pos(positions)
+            h = h + self.embed_pos(positions + self.cfg.pos_offset)
+        if self.cfg.embed_ln:
+            h = self.ln_embed(h)
         return h
 
     def unembed(self, h):
@@ -276,10 +348,12 @@ class TransformerLM(nn.Module):
         h_final) where h_split is the activation entering block `split`."""
         if positions is None:
             positions = self._default_positions(tokens, attn_mask)
-        fused = self.cfg.attn_impl in ("flash", "ring")
+        fused = self.cfg.attn_impl in ("flash", "ring") and not self.cfg.alibi
         # Fused kernels build causal+padding structure from attn_mask
         # blockwise — skip materializing the O(t^2) bias tensor entirely.
         bias = None if fused else causal_bias(attn_mask)
+        if bias is not None and self.cfg.alibi:
+            bias = bias + alibi_bias(attn_mask, self.cfg.n_heads)
         h = self.embed(tokens, positions)
         h, _ = self.run_blocks(h, bias, positions, 0, split, attn_mask=attn_mask)
         h_split = h
@@ -299,8 +373,10 @@ class TransformerLM(nn.Module):
         modeling_ppo.py:410-453) when applied with reference params."""
         if positions is None:
             positions = self._default_positions(h, attn_mask)
-        fused = self.cfg.attn_impl in ("flash", "ring")
+        fused = self.cfg.attn_impl in ("flash", "ring") and not self.cfg.alibi
         bias = None if fused else causal_bias(attn_mask)
+        if bias is not None and self.cfg.alibi:
+            bias = bias + alibi_bias(attn_mask, self.cfg.n_heads)
         h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers, attn_mask=attn_mask)
         logits, _ = self.unembed(h)
         return logits
@@ -328,6 +404,8 @@ class TransformerLM(nn.Module):
             cache["mask"], token_mask.astype(cache["mask"].dtype), (0, index)
         )
         bias = decode_bias(new_mask, t)
+        if self.cfg.alibi:
+            bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
         if is_prefill:
             # causal structure within the prefill block
             S = cache["mask"].shape[-1]
@@ -395,6 +473,60 @@ PRESETS: Dict[str, Dict[str, Any]] = {
         d_model=4096, n_layers=32, n_heads=32, d_ff=11008, max_seq_len=4096,
         pos_embed="rope", norm="rmsnorm", activation="silu", glu=True,
         tie_embeddings=False, use_bias=False,
+    ),
+    # GPT-NeoX / pythia family (HH-RLHF suite, examples/hh/ppo_hh.py:71-107)
+    "neox-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256,
+        pos_embed="rope", rotary_pct=0.25, activation="gelu_exact",
+        parallel_residual=True, tie_embeddings=False,
+    ),
+    "pythia-160m": dict(
+        d_model=768, n_layers=12, n_heads=12, d_ff=3072, max_seq_len=2048,
+        pos_embed="rope", rotary_pct=0.25, activation="gelu_exact",
+        parallel_residual=True, tie_embeddings=False,
+    ),
+    "pythia-1.4b": dict(
+        d_model=2048, n_layers=24, n_heads=16, d_ff=8192, max_seq_len=2048,
+        pos_embed="rope", rotary_pct=0.25, activation="gelu_exact",
+        parallel_residual=True, tie_embeddings=False,
+    ),
+    "pythia-6.9b": dict(
+        d_model=4096, n_layers=32, n_heads=32, d_ff=16384, max_seq_len=2048,
+        pos_embed="rope", rotary_pct=0.25, activation="gelu_exact",
+        parallel_residual=True, tie_embeddings=False,
+    ),
+    # GPT-J-6B (HH examples default model, examples/hh/ppo_hh.py:96-100)
+    "gptj-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256,
+        pos_embed="rope", rotary_pct=0.5, parallel_residual=True, shared_ln=True,
+        tie_embeddings=False, attn_bias=False, lm_head_bias=True,
+    ),
+    "gptj-6b": dict(
+        d_model=4096, n_layers=28, n_heads=16, d_ff=16384, max_seq_len=2048,
+        pos_embed="rope", rotary_pct=0.25, parallel_residual=True, shared_ln=True,
+        tie_embeddings=False, attn_bias=False, lm_head_bias=True,
+    ),
+    # OPT family (OPTModelBranch, modeling_ppo.py:689-813)
+    "opt-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256,
+        activation="relu", pos_offset=2,
+    ),
+    "opt-125m": dict(
+        d_model=768, n_layers=12, n_heads=12, d_ff=3072, max_seq_len=2048,
+        activation="relu", pos_offset=2,
+    ),
+    # Bloom family (BloomModelBranch, modeling_ppo.py:816-929)
+    "bloom-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256,
+        pos_embed="none", alibi=True, embed_ln=True,
+    ),
+    "bloom-560m": dict(
+        d_model=1024, n_layers=24, n_heads=16, d_ff=4096, max_seq_len=2048,
+        pos_embed="none", alibi=True, embed_ln=True,
+    ),
+    # GPTBigCode / starcoder (MQA, GPTBigCodeModelBranch, modeling_ppo.py:1079-1222)
+    "bigcode-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, n_kv_heads=1, d_ff=256, max_seq_len=256,
     ),
 }
 

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from trlx_tpu.models.transformer import (
     Block,
     TransformerConfig,
+    alibi_bias,
     causal_bias,
     position_ids,
 )
@@ -132,8 +133,11 @@ def gpipe_blocks(
         positions = position_ids(mask)
         # Fused attention impls build causal+padding structure blockwise
         # from the mask — skip the O(t^2) bias tensor (as in
-        # TransformerLM.__call__, transformer.py:278-281).
-        bias = None if cfg.attn_impl in ("flash", "ring") else causal_bias(mask)
+        # TransformerLM.__call__; ALiBi needs the dense-bias path).
+        fused = cfg.attn_impl in ("flash", "ring") and not cfg.alibi
+        bias = None if fused else causal_bias(mask)
+        if bias is not None and cfg.alibi:
+            bias = bias + alibi_bias(mask, cfg.n_heads)
         return _apply_layer_stack(cfg, my_layers, x, bias, positions, mask)
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound

@@ -1,0 +1,228 @@
+"""LoRA/peft parity tests, mirroring the reference's tests/test_peft.py
+invariants: adapter-only training, adapter-disabled (reference) forward
+equivalence, merge-and-unload export, checkpoint shape, and the full PPO
+path with a peft_config.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from flax import traverse_util  # noqa: E402
+
+from trlx_tpu.data.configs import ModelConfig  # noqa: E402
+from trlx_tpu.data.default_configs import default_ppo_config  # noqa: E402
+from trlx_tpu.models import (  # noqa: E402
+    CausalLMWithValueHead,
+    build_model,
+    config_from_preset,
+    forward_policy_and_ref,
+    ref_param_subtree,
+    resolve_split,
+    trainable_mask,
+)
+from trlx_tpu.models.lora import (  # noqa: E402
+    lora_overrides_from_peft_config,
+    merge_lora_into_params,
+    split_lora,
+    zero_lora,
+)
+
+PEFT_CONFIG = {"peft_type": "LORA", "r": 4, "lora_alpha": 16}
+
+
+def _build(lora=True):
+    overrides = lora_overrides_from_peft_config(PEFT_CONFIG) if lora else {}
+    cfg = config_from_preset("gpt2-tiny", vocab_size=64, dtype=jnp.float32, **overrides)
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 12)), jnp.int32)
+    mask = jnp.ones_like(tokens)
+    params = model.init(jax.random.PRNGKey(0), tokens, mask)["params"]
+    return cfg, model, params, tokens, mask
+
+
+def _perturb_lora(params, scale=0.3):
+    """Give the adapters nonzero weights (as training would)."""
+
+    def bump(path, x):
+        name = str(path[-1].key if hasattr(path[-1], "key") else path[-1])
+        if "_lora_" in name:
+            import zlib
+
+            key = jax.random.fold_in(jax.random.PRNGKey(7), zlib.crc32(name.encode()))
+            return x + scale * jax.random.normal(key, x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(bump, params)
+
+
+def test_overrides_translation():
+    ov = lora_overrides_from_peft_config(PEFT_CONFIG)
+    assert ov == {"lora_rank": 4, "lora_alpha": 16.0}
+    ov = lora_overrides_from_peft_config(
+        {"peft_type": "LORA", "r": 2, "target_modules": ["q_proj", "o_proj"]}
+    )
+    assert ov["lora_targets"] == ("q_proj", "o_proj")
+    with pytest.raises(ValueError):
+        lora_overrides_from_peft_config({"peft_type": "PREFIX_TUNING"})
+
+
+def test_adapter_params_exist_and_only_adapters_train():
+    cfg, model, params, *_ = _build()
+    lora_leaves, base_leaves = split_lora(params)
+    # default targets q_proj+v_proj, 2 layers, a+b each
+    assert len(lora_leaves) == 2 * 2 * 2
+    for k, v in lora_leaves.items():
+        assert 4 in v.shape  # rank dim
+
+    mask = trainable_mask(params, cfg, num_layers_unfrozen=-1)
+    flat_mask = traverse_util.flatten_dict(mask)
+    for k, m in flat_mask.items():
+        if any("_lora_" in str(p) for p in k):
+            assert m, k
+        elif str(k[0]) == "lm":
+            assert not m, k  # all base LM weights frozen under peft
+        else:
+            assert m, k  # v_head stays trainable
+
+
+def test_init_is_identity_and_zero_lora_equivalence():
+    """B=0 at init => lora model == base model; zero_lora == disabling."""
+    cfg, model, params, tokens, mask = _build()
+    logits, values, _ = model.apply({"params": params}, tokens, mask)
+
+    perturbed = _perturb_lora(params)
+    logits_pert, *_ = model.apply({"params": perturbed}, tokens, mask)
+    assert not np.allclose(np.asarray(logits), np.asarray(logits_pert), atol=1e-5)
+
+    disabled = zero_lora(perturbed)
+    logits_dis, *_ = model.apply({"params": disabled}, tokens, mask)
+    np.testing.assert_allclose(np.asarray(logits_dis), np.asarray(logits), atol=1e-6)
+
+
+def test_ref_logits_are_adapter_disabled():
+    """The hydra replacement under peft: split forced to 0 and ref logits
+    equal the base model's even after adapter updates."""
+    cfg, model, params, tokens, mask = _build()
+    assert resolve_split(cfg, 2) == 0
+
+    perturbed = _perturb_lora(params)
+    ref = ref_param_subtree({"lm": perturbed["lm"], "v_head": perturbed["v_head"]}, cfg, 0)
+    logits, values, ref_logits = forward_policy_and_ref(
+        model, perturbed, ref, tokens, mask, split=0
+    )
+    base_logits, *_ = model.apply({"params": zero_lora(perturbed)}, tokens, mask)
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(base_logits), atol=1e-6)
+    assert not np.allclose(np.asarray(logits), np.asarray(ref_logits), atol=1e-5)
+
+
+def test_merge_and_unload():
+    cfg, model, params, tokens, mask = _build()
+    perturbed = _perturb_lora(params)
+    merged = merge_lora_into_params(perturbed, cfg)
+    assert not any("_lora_" in str(p) for p in
+                   (p for k in traverse_util.flatten_dict(merged) for p in k))
+
+    logits_lora, *_ = model.apply({"params": perturbed}, tokens, mask)
+    # merged params must run on a lora-free config (same module graph minus
+    # adapters)
+    cfg_plain = config_from_preset("gpt2-tiny", vocab_size=64, dtype=jnp.float32)
+    model_plain = CausalLMWithValueHead(cfg_plain)
+    logits_merged, *_ = model_plain.apply(
+        {"params": jax.tree_util.tree_map(jnp.asarray, merged)}, tokens, mask
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_merged), np.asarray(logits_lora), atol=1e-5
+    )
+
+
+def test_build_model_with_peft_config():
+    mc = ModelConfig(model_path="random:gpt2-tiny", peft_config=PEFT_CONFIG,
+                     model_extra_configs={"dtype": "float32"})
+    model, cfg, params = build_model(mc, vocab_size=64)
+    assert cfg.lora_rank == 4
+    lora_leaves, _ = split_lora(params)
+    assert lora_leaves
+
+
+def test_hf_load_with_lora_template(tmp_path):
+    """Loading an HF checkpoint into a LoRA-enabled model keeps the freshly
+    initialized adapters and fills only the base weights."""
+    torch = pytest.importorskip("torch")
+    import transformers as tf
+
+    from trlx_tpu.models import hf_interop
+
+    torch.manual_seed(0)
+    hf_model = tf.GPT2LMHeadModel(
+        tf.GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2, n_head=2)
+    )
+    hf_model.eval()
+    path = str(tmp_path / "gpt2")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    cfg = hf_interop.config_from_hf(path, dtype=jnp.float32, lora_rank=4)
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    params = hf_interop.load_params_from_hf(path, cfg, template)
+
+    lora_leaves, _ = split_lora(params)
+    assert lora_leaves
+    with torch.no_grad():
+        ref = hf_model(input_ids=torch.zeros((1, 8), dtype=torch.long)).logits.numpy()
+    logits, *_ = model.apply({"params": params}, tokens, jnp.ones_like(tokens))
+    np.testing.assert_allclose(np.asarray(logits)[0], ref[0], atol=2e-3)
+
+
+def test_ppo_trainer_with_peft(tmp_path):
+    """End-to-end: trainer trains only adapters+heads; a train step leaves
+    base weights untouched; orbax checkpoint holds the small tree."""
+    from trlx_tpu.data import PPORLElement
+    from trlx_tpu.pipeline import MiniBatchIterator
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", peft_config=PEFT_CONFIG),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, tracker=None,
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(gen_kwargs=dict(max_new_tokens=8, do_sample=True)),
+    )
+    trainer = PPOTrainer(config, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+
+    # trainable tree is adapters + v_head only
+    for k in trainer.train_params:
+        assert any("_lora_" in str(p) for p in k) or str(k[0]) == "v_head", k
+
+    base_before = {k: np.asarray(v).copy() for k, v in trainer.frozen_params.items()}
+
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        trainer.store.push([
+            PPORLElement(
+                query_tensor=rng.integers(3, 60, size=6).astype(np.int32),
+                response_tensor=rng.integers(3, 60, size=6).astype(np.int32),
+                logprobs=rng.normal(size=6).astype(np.float32),
+                values=rng.normal(size=6).astype(np.float32),
+                rewards=rng.normal(size=6).astype(np.float32),
+            )
+        ])
+    loader = trainer.store.create_loader(4, shuffle=False)
+    for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+        trainer.train_minibatch(minibatch)
+        break
+
+    for k, v in trainer.frozen_params.items():
+        np.testing.assert_array_equal(np.asarray(v), base_before[k], err_msg=str(k))
+
+    lora_changed = any(
+        not np.allclose(np.asarray(v), 0.0)
+        for k, v in trainer.train_params.items()
+        if str(k[-1]).endswith("_lora_b")
+    )
+    assert lora_changed, "adapter B matrices still zero after a train step"
+
+    trainer.save(str(tmp_path / "ckpt"))
+    trainer.load(str(tmp_path / "ckpt"))

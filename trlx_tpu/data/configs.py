@@ -35,7 +35,9 @@ def _merge_dicts(base: Dict, update: Dict) -> Dict:
     base = deepcopy(base)
     for k, v in update.items():
         if isinstance(v, dict):
-            base[k] = _merge_dicts(base.get(k, {}), v)
+            # `or {}` so a dict can replace an explicit None default
+            # (e.g. evolving model.peft_config from None to a LoRA dict)
+            base[k] = _merge_dicts(base.get(k) or {}, v)
         else:
             base[k] = v
     return base

@@ -76,6 +76,13 @@ def resolve_transformer_config(model_config, vocab_size: int):
     if "dtype" in extra:
         dtype_overrides["dtype"] = jnp.dtype(extra.pop("dtype"))
     seq2seq = getattr(model_config, "model_arch_type", "causal") == "seq2seq"
+    peft_config = getattr(model_config, "peft_config", None)
+    if peft_config is not None:
+        if seq2seq:
+            raise NotImplementedError("LoRA is only supported for causal models")
+        from trlx_tpu.models.lora import lora_overrides_from_peft_config
+
+        dtype_overrides.update(lora_overrides_from_peft_config(peft_config))
     if path.startswith("random:"):
         preset = path[len("random:"):]
         if preset in SEQ2SEQ_PRESETS and not seq2seq:
@@ -123,6 +130,20 @@ def build_model(
         mask = jnp.ones_like(tokens)
         params = model.init(rng, tokens, mask)["params"]
 
+    if getattr(cfg, "lora_rank", 0) > 0:
+        from trlx_tpu.models.lora import split_lora
+
+        lora_leaves, _ = split_lora(params)
+        if not lora_leaves:
+            # e.g. HF-native target_modules names ('c_attn',
+            # 'query_key_value') — every family here uses q/k/v/o_proj,
+            # up/gate/down_proj; silently training heads-only would be a
+            # footgun.
+            raise ValueError(
+                f"peft_config target modules {cfg.lora_targets} matched no "
+                "projection; valid targets: q_proj, k_proj, v_proj, o_proj, "
+                "up_proj, gate_proj, down_proj"
+            )
     if not model_config.model_path.startswith("random:"):
         from trlx_tpu.models import hf_interop
 

@@ -460,6 +460,7 @@ def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict
     lm = _LOADERS[fam](sd, cfg)
 
     import jax
+    from flax import traverse_util
 
     def dt(template_leaf, arr):
         a = np.asarray(arr, dtype=np.dtype(template_leaf.dtype))
@@ -469,8 +470,20 @@ def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict
             )
         return a
 
+    # LoRA adapter leaves exist only in the template (freshly initialized,
+    # not in the HF checkpoint) — split them out, map the base weights,
+    # then re-attach the initialized adapters.
+    from trlx_tpu.models.lora import split_lora
+
+    lora_leaves, base_flat = split_lora(params_template["lm"])
+    base_tpl = traverse_util.unflatten_dict(base_flat)
+    mapped = jax.tree_util.tree_map(dt, base_tpl, lm)
+    new_lm = traverse_util.unflatten_dict(
+        {**traverse_util.flatten_dict(mapped), **lora_leaves}
+    )
+
     new_params = dict(params_template)
-    new_params["lm"] = jax.tree_util.tree_map(dt, params_template["lm"], lm)
+    new_params["lm"] = new_lm
     logger.info(f"Loaded HF weights ({fam}) from {path}")
     return new_params
 

@@ -1,0 +1,100 @@
+"""LoRA adapter utilities — the reference's peft integration, TPU-style.
+
+The reference wraps models with the peft library (modeling_base.py:123-326:
+adapter creation, trained-adapter loading, heads-only checkpoints) and gets
+reference logits by disabling the adapter (tested in tests/test_peft.py).
+Here adapters are just extra leaves in the param pytree
+(`<name>_lora_a/b`, declared in trlx_tpu/models/transformer.py:lora_dense):
+
+- trainable/frozen split: `policy.trainable_mask` marks only adapter +
+  head leaves trainable when cfg.lora_rank > 0, so the orbax trainer
+  state is adapters+heads only — the analogue of peft checkpoints;
+- reference logits: zero the adapter leaves (`zero_lora`) — a pure
+  adapter-disabled forward, no second model copy;
+- export: `merge_lora_into_params` folds A·B·(α/r) into the base kernels
+  for HF-format `save_pretrained` (peft's merge_and_unload).
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def lora_overrides_from_peft_config(peft_config: Any) -> Dict[str, Any]:
+    """Translate a reference-style peft config (dict or peft.LoraConfig)
+    into TransformerConfig overrides. Accepts the keys the reference's
+    examples use (examples/ppo_sentiments_peft.py): peft_type=LORA, r,
+    lora_alpha, target_modules."""
+    if peft_config is None:
+        return {}
+    if not isinstance(peft_config, dict):
+        peft_config = {
+            k: getattr(peft_config, k)
+            for k in ("peft_type", "r", "lora_alpha", "target_modules")
+            if hasattr(peft_config, k)
+        }
+    peft_type = peft_config.get("peft_type", "LORA")
+    # peft.PeftType is a str-enum whose str() is "PeftType.LORA" — compare
+    # the enum value, not its repr
+    peft_type = str(getattr(peft_type, "value", peft_type)).upper()
+    if peft_type != "LORA":
+        raise ValueError(f"Unsupported peft_type '{peft_type}' (only LORA)")
+    overrides: Dict[str, Any] = {"lora_rank": int(peft_config.get("r", 8))}
+    if "lora_alpha" in peft_config:
+        overrides["lora_alpha"] = float(peft_config["lora_alpha"])
+    if peft_config.get("target_modules"):
+        overrides["lora_targets"] = tuple(peft_config["target_modules"])
+    return overrides
+
+
+def is_lora_path(path_keys) -> bool:
+    return any("_lora_" in str(getattr(k, "key", k)) for k in path_keys)
+
+
+def zero_lora(params: Dict) -> Dict:
+    """Adapter-disabled view: lora leaves -> zeros, base leaves aliased
+    (no copy). With rank>0 the base is frozen, so the aliased leaves are
+    never donated/mutated — safe to hold as the reference branch."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: jnp.zeros_like(x) if is_lora_path(p) else x, params
+    )
+
+
+def split_lora(params: Dict) -> Tuple[Dict, Dict]:
+    """(lora leaves, base leaves) as flat {path-tuple: leaf} dicts."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    lora = {k: v for k, v in flat.items() if any("_lora_" in str(p) for p in k)}
+    base = {k: v for k, v in flat.items() if k not in lora}
+    return lora, base
+
+
+def merge_lora_into_params(params: Dict, cfg) -> Dict:
+    """Fold every adapter into its base kernel (peft merge_and_unload):
+    kernel' = kernel + A @ B · (α/r); adapter leaves are dropped. Returns
+    a host-side (numpy) pytree suitable for export."""
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    scale = cfg.lora_alpha / max(cfg.lora_rank, 1)
+    out = {}
+    for key, leaf in flat.items():
+        last = str(key[-1])
+        if "_lora_" in last:
+            continue
+        out[key] = np.asarray(leaf)
+    for key, leaf in flat.items():
+        last = str(key[-1])
+        if not last.endswith("_lora_a"):
+            continue
+        target = last[: -len("_lora_a")]
+        b_key = key[:-1] + (f"{target}_lora_b",)
+        kernel_key = key[:-1] + (target, "kernel")
+        a = np.asarray(leaf, np.float32)
+        b = np.asarray(flat[b_key], np.float32)
+        base = np.asarray(out[kernel_key], np.float32)
+        out[kernel_key] = (base + (a @ b) * scale).astype(out[kernel_key].dtype)
+    return traverse_util.unflatten_dict(out)

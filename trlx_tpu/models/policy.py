@@ -90,7 +90,16 @@ def resolve_split(cfg: TransformerConfig, num_layers_unfrozen: int) -> int:
     (utils/modeling.py:22-38): -1 = everything trainable (split 0 with a
     full reference copy), 0 = whole LM frozen (heads-only training; split
     n_layers, ref branch is just the frozen unembedding), k>0 = top k
-    blocks trainable."""
+    blocks trainable.
+
+    With LoRA adapters the branch-point trick is invalid (adapters live in
+    every block, so hidden states below any split already diverge from the
+    base model) — the reference likewise disables the hydra branch under
+    peft and gets ref logits from an adapter-disabled pass; split 0 means
+    a full reference forward (with zeroed adapters, see
+    trlx_tpu/models/lora.py:zero_lora)."""
+    if getattr(cfg, "lora_rank", 0) > 0:
+        return 0
     if num_layers_unfrozen == -1:
         return 0
     if num_layers_unfrozen == 0:
@@ -108,8 +117,17 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
 
     Leaves are materialized as NEW buffers (jnp.copy): the reference copy
     must not alias the live params, which get donated into the jitted train
-    step and would otherwise be deleted under it."""
+    step and would otherwise be deleted under it.
+
+    With LoRA the base weights are all frozen (never donated), so the
+    reference is simply an adapter-disabled view: base leaves aliased,
+    adapter leaves zeroed — no full model copy, same memory story as the
+    reference's peft adapter-disable."""
     lm = params["lm"]
+    if getattr(cfg, "lora_rank", 0) > 0:
+        from trlx_tpu.models.lora import zero_lora
+
+        return zero_lora(lm)
     if split == 0:
         return jax.tree_util.tree_map(jnp.copy, lm)
     subtree = {}
@@ -128,11 +146,18 @@ def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: in
     trainable; `num_layers_unfrozen` follows reference semantics
     (-1 all LM params, 0 none, k>0 top-k blocks + final norm)."""
     split = resolve_split(cfg, num_layers_unfrozen)
+    lora = getattr(cfg, "lora_rank", 0) > 0
 
     def _mask(path_keys, leaf):
         parts = [getattr(k, "key", str(k)) for k in path_keys]
         if parts[0] != "lm":
             return True  # v_head / ilql_heads / any auxiliary head
+        if lora:
+            # peft semantics: only adapters (+ heads above) train; every
+            # base LM weight is frozen regardless of num_layers_unfrozen.
+            from trlx_tpu.models.lora import is_lora_path
+
+            return is_lora_path(path_keys)
         if num_layers_unfrozen == -1:
             return True
         if num_layers_unfrozen == 0:

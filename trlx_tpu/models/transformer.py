@@ -56,6 +56,13 @@ class TransformerConfig:
     # the exact source layout (structure-based inference is ambiguous, e.g.
     # non-MQA GPTBigCode vs GPT-2); None = infer from structure.
     hf_family: Optional[str] = None
+    # LoRA adapters (the reference's peft integration, modeling_base.py
+    # from_pretrained + test_peft.py): rank 0 = disabled. Adapter params
+    # live beside their base kernels as `<name>_lora_a` / `<name>_lora_b`
+    # leaves — a separate trainable subtree, with the base weights frozen.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q_proj", "v_proj")
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
@@ -136,6 +143,32 @@ def alibi_bias(key_mask: jnp.ndarray, n_heads: int) -> jnp.ndarray:
     return (slopes[None, :, None, None] * k_pos[:, None, None, :]).astype(jnp.float32)
 
 
+def lora_dense(mod: nn.Module, cfg: TransformerConfig, feats: int, name: str, use_bias: bool):
+    """A Dense layer with an optional LoRA adapter (y += x·A·B · α/r).
+    Adapter leaves sit beside the base kernel in the param tree
+    (`<name>_lora_a/b`), so base weights keep their HF-interop layout and
+    the adapter subtree can be masked/saved/zeroed independently —
+    functionally what the reference gets from peft wrapping
+    (modeling_base.py:123-326)."""
+    base = nn.Dense(feats, use_bias=use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
+    if cfg.lora_rank <= 0 or name not in cfg.lora_targets:
+        return base
+
+    def fwd(x):
+        y = base(x)
+        a = mod.param(
+            f"{name}_lora_a",
+            nn.initializers.normal(stddev=1.0 / cfg.lora_rank),
+            (x.shape[-1], cfg.lora_rank),
+            cfg.param_dtype,
+        )
+        b = mod.param(f"{name}_lora_b", nn.initializers.zeros, (cfg.lora_rank, feats), cfg.param_dtype)
+        scale = cfg.lora_alpha / cfg.lora_rank
+        return y + (x.astype(cfg.dtype) @ a.astype(cfg.dtype)) @ b.astype(cfg.dtype) * scale
+
+    return fwd
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
@@ -153,9 +186,7 @@ class Attention(nn.Module):
         b, t, d = h.shape
         nh, nkv, hd = cfg.n_heads, cfg.kv_heads, cfg.head_dim
         bias_flag = cfg.use_bias if cfg.attn_bias is None else cfg.attn_bias
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=bias_flag, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
-        )
+        dense = lambda feats, name: lora_dense(self, cfg, feats, name, bias_flag)
         q = dense(nh * hd, "q_proj")(h).reshape(b, t, nh, hd)
         k = dense(nkv * hd, "k_proj")(h).reshape(b, t, nkv, hd)
         v = dense(nkv * hd, "v_proj")(h).reshape(b, t, nkv, hd)
@@ -210,9 +241,7 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.cfg
-        dense = lambda feats, name: nn.Dense(
-            feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
-        )
+        dense = lambda feats, name: lora_dense(self, cfg, feats, name, cfg.use_bias)
         act = {
             "silu": jax.nn.silu,
             "relu": jax.nn.relu,

@@ -98,6 +98,11 @@ GPT_RULES = ShardingRules(
         (r"down_proj/kernel", ("tensor", "fsdp")),
         (r"down_proj/bias", (None,)),
         (r"lm_head/kernel", ("fsdp", "tensor")),
+        # LoRA adapters: A [in, r] row-split like its base kernel's input
+        # dim; B [r, out] column-split so the adapter delta lands with the
+        # same output sharding as the base projection it adds into.
+        (r"\w+_lora_a", ("fsdp", None)),
+        (r"\w+_lora_b", (None, "tensor")),
         (r"(ln_\w+|norm\w*|layernorm)/(scale|bias)", (None,)),
         # value / Q heads: first layer column-split, output layer replicated
         (r"(v_head|q_head|target_q_head)\w*/dense_in/kernel", ("fsdp", "tensor")),

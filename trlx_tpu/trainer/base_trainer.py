@@ -524,7 +524,13 @@ class TPUTrainer(BaseRLTrainer):
 
             from trlx_tpu.models.hf_interop import params_to_hf_state_dict
 
-            sd = params_to_hf_state_dict(self.params, self.model_cfg)
+            params = self.params
+            if getattr(self.model_cfg, "lora_rank", 0) > 0:
+                # fold adapters into the base kernels (peft merge_and_unload)
+                from trlx_tpu.models.lora import merge_lora_into_params
+
+                params = merge_lora_into_params(params, self.model_cfg)
+            sd = params_to_hf_state_dict(params, self.model_cfg)
             torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
                        os.path.join(directory, "pytorch_model.bin"))
         except Exception as e:  # model family without HF layout — save msgpack

@@ -1,0 +1,74 @@
+// Native host-side data engine for trlx_tpu.
+//
+// The reference delegates its host-side hot loops to native code in torch /
+// its DataLoader workers (SURVEY.md §2.6); here the equivalent per-step
+// host work — collating variable-length rollout sequences into the padded
+// static-shape batches XLA consumes — runs in C++ behind a ctypes boundary
+// (trlx_tpu/native.py), with a pure-numpy fallback when no toolchain is
+// available.
+//
+// Build: g++ -O3 -march=native -shared -fPIC trlx_native.cpp -o libtrlx_native.so
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+
+extern "C" {
+
+// Pad-and-stack n variable-length rows into out[n, max_len].
+// seqs: array of n row pointers; lens: row lengths; left: pad side.
+// out must be pre-filled by the caller only if rows can be shorter than
+// max_len — we fill the padding ourselves, so no pre-fill is needed.
+void pad_stack_i32(const int32_t** seqs, const int64_t* lens, int64_t n,
+                   int64_t max_len, int32_t pad, int left, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t* row = out + i * max_len;
+        int64_t len = std::min(lens[i], max_len);
+        int64_t pad_len = max_len - len;
+        if (left) {
+            std::fill(row, row + pad_len, pad);
+            std::memcpy(row + pad_len, seqs[i], len * sizeof(int32_t));
+        } else {
+            std::memcpy(row, seqs[i], len * sizeof(int32_t));
+            std::fill(row + len, row + max_len, pad);
+        }
+    }
+}
+
+void pad_stack_f32(const float** seqs, const int64_t* lens, int64_t n,
+                   int64_t max_len, float pad, int left, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        float* row = out + i * max_len;
+        int64_t len = std::min(lens[i], max_len);
+        int64_t pad_len = max_len - len;
+        if (left) {
+            std::fill(row, row + pad_len, pad);
+            std::memcpy(row + pad_len, seqs[i], len * sizeof(float));
+        } else {
+            std::memcpy(row, seqs[i], len * sizeof(float));
+            std::fill(row + len, row + max_len, pad);
+        }
+    }
+}
+
+// Fused PPO collate: one call builds the whole PPORLBatch (queries
+// left-or-right padded with pad_id; responses right-padded with pad_id;
+// logprobs/values/rewards right-padded with 0) — one C boundary crossing
+// per minibatch instead of five.
+void ppo_collate(const int32_t** queries, const int64_t* q_lens,
+                 const int32_t** responses, const int64_t* r_lens,
+                 const float** logprobs, const int64_t* lp_lens,
+                 const float** values, const int64_t* v_lens,
+                 const float** rewards, const int64_t* rw_lens,
+                 int64_t n, int64_t max_q, int64_t max_r, int64_t max_p,
+                 int32_t pad_id, int left_queries,
+                 int32_t* out_q, int32_t* out_r,
+                 float* out_lp, float* out_v, float* out_rw) {
+    pad_stack_i32(queries, q_lens, n, max_q, pad_id, left_queries, out_q);
+    pad_stack_i32(responses, r_lens, n, max_r, pad_id, 0, out_r);
+    pad_stack_f32(logprobs, lp_lens, n, max_p, 0.0f, 0, out_lp);
+    pad_stack_f32(values, v_lens, n, max_p, 0.0f, 0, out_v);
+    pad_stack_f32(rewards, rw_lens, n, max_p, 0.0f, 0, out_rw);
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""Native (C++) data engine: build, parity with the numpy fallback, and
+the fused PPO collate used by PPORolloutStorage."""
+
+import os
+
+import numpy as np
+import pytest
+
+from trlx_tpu import native
+from trlx_tpu.data import PPORLElement
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def _rand_seqs(rng, n, dtype, lo=0, hi=100):
+    lens = rng.integers(1, 9, size=n)
+    if np.dtype(dtype) == np.int32:
+        return [rng.integers(lo, hi, size=L).astype(dtype) for L in lens]
+    return [rng.normal(size=L).astype(dtype) for L in lens]
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+@pytest.mark.parametrize("left", [False, True])
+def test_pad_stack_parity(lib, dtype, left):
+    rng = np.random.default_rng(0)
+    seqs = _rand_seqs(rng, 16, dtype)
+    got = native.pad_stack(seqs, 7, 10, dtype, left=left)
+
+    ref = np.full((16, 10), 7, dtype=dtype)
+    for i, s in enumerate(seqs):
+        if left:
+            ref[i, 10 - len(s):] = s
+        else:
+            ref[i, : len(s)] = s
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pad_stack_truncates(lib):
+    out = native.pad_stack([np.arange(20, dtype=np.int32)], 0, 5, np.int32)
+    np.testing.assert_array_equal(out[0], np.arange(5))
+
+
+def test_ppo_collate_matches_fallback(lib):
+    rng = np.random.default_rng(1)
+    elems = []
+    for _ in range(8):
+        ql, rl = int(rng.integers(1, 7)), int(rng.integers(1, 6))
+        elems.append(PPORLElement(
+            query_tensor=rng.integers(0, 50, ql).astype(np.int32),
+            response_tensor=rng.integers(0, 50, rl).astype(np.int32),
+            logprobs=rng.normal(size=rl).astype(np.float32),
+            values=rng.normal(size=rl).astype(np.float32),
+            rewards=rng.normal(size=rl).astype(np.float32),
+        ))
+    args = (elems, 8, 7, 7, 3, True)
+    got = native.ppo_collate(*args)
+
+    os.environ["TRLX_TPU_NO_NATIVE"] = "1"
+    native._lib, native._load_attempted = None, False
+    try:
+        ref = native.ppo_collate(*args)
+    finally:
+        del os.environ["TRLX_TPU_NO_NATIVE"]
+        native._lib, native._load_attempted = None, False
+
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(g, r)
+
+
+def test_ppo_collate_ragged_field_lengths(lib):
+    """values/rewards shorter than logprobs must pad with zeros, not read
+    past the buffer (regression: the C call once reused logprob lengths
+    for every float field)."""
+    elems = [PPORLElement(
+        query_tensor=np.asarray([1, 2], np.int32),
+        response_tensor=np.asarray([3, 4, 5], np.int32),
+        logprobs=np.asarray([0.1, 0.2, 0.3], np.float32),
+        values=np.asarray([0.5], np.float32),
+        rewards=np.asarray([0.7, 0.8], np.float32),
+    )]
+    q, r, lp, v, rw = native.ppo_collate(elems, 2, 3, 3, 0, True)
+    np.testing.assert_allclose(v, [[0.5, 0.0, 0.0]], atol=0)
+    np.testing.assert_allclose(rw, [[0.7, 0.8, 0.0]], atol=0)
+    np.testing.assert_allclose(lp, [[0.1, 0.2, 0.3]], atol=0)
+
+
+def test_rollout_storage_uses_native_layout(lib):
+    """End-to-end through PPORolloutStorage: queries left-padded, seam at a
+    fixed column (reference ppo_collate_fn, ppo_pipeline.py:14-50)."""
+    from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+    store = PPORolloutStorage(pad_token_id=9, padding_side="left")
+    store.push([
+        PPORLElement(
+            query_tensor=np.asarray([1, 2], np.int32),
+            response_tensor=np.asarray([3], np.int32),
+            logprobs=np.asarray([0.1], np.float32),
+            values=np.asarray([0.2], np.float32),
+            rewards=np.asarray([0.3], np.float32),
+        ),
+        PPORLElement(
+            query_tensor=np.asarray([4, 5, 6], np.int32),
+            response_tensor=np.asarray([7, 8], np.int32),
+            logprobs=np.asarray([0.4, 0.5], np.float32),
+            values=np.asarray([0.6, 0.7], np.float32),
+            rewards=np.asarray([0.8, 0.9], np.float32),
+        ),
+    ])
+    batch = next(iter(store.create_loader(2, shuffle=False)))
+    np.testing.assert_array_equal(batch.query_tensors, [[9, 1, 2], [4, 5, 6]])
+    np.testing.assert_array_equal(batch.response_tensors, [[3, 9], [7, 8]])
+    np.testing.assert_allclose(batch.logprobs, [[0.1, 0.0], [0.4, 0.5]], atol=1e-6)

@@ -206,10 +206,11 @@ class PromptPipeline(BasePipeline):
 
 
 def _pad_stack(seqs: List[np.ndarray], pad_value, max_len: int, dtype) -> np.ndarray:
-    out = np.full((len(seqs), max_len), pad_value, dtype=dtype)
-    for i, s in enumerate(seqs):
-        out[i, : len(s)] = s
-    return out
+    # native.pad_stack dispatches to the C++ engine for i32/f32 and
+    # contains the numpy fallback for everything else
+    from trlx_tpu.native import pad_stack
+
+    return pad_stack(seqs, pad_value, max_len, dtype)
 
 
 class ILQLRolloutStorage(BaseRolloutStore):

@@ -64,23 +64,13 @@ class PPORolloutStorage(BaseRolloutStore):
         left_queries = self.padding_side == "left"
 
         def collate(elems: List[PPORLElement]) -> PPORLBatch:
-            b = len(elems)
-            queries = np.full((b, max_q), pad_id, dtype=np.int32)
-            responses = np.full((b, max_r), pad_id, dtype=np.int32)
-            logprobs = np.zeros((b, max_p), dtype=np.float32)
-            values = np.zeros((b, max_p), dtype=np.float32)
-            rewards = np.zeros((b, max_p), dtype=np.float32)
-            for i, e in enumerate(elems):
-                q = np.asarray(e.query_tensor)
-                r = np.asarray(e.response_tensor)
-                if left_queries:
-                    queries[i, max_q - len(q):] = q
-                else:
-                    queries[i, : len(q)] = q
-                responses[i, : len(r)] = r
-                logprobs[i, : len(e.logprobs)] = e.logprobs
-                values[i, : len(e.values)] = e.values
-                rewards[i, : len(e.rewards)] = e.rewards
+            # Fused native collation (trlx_tpu/native.py; numpy fallback
+            # inside) — the host-side hot path of every optimizer step.
+            from trlx_tpu.native import ppo_collate
+
+            queries, responses, logprobs, values, rewards = ppo_collate(
+                elems, max_q, max_r, max_p, pad_id, left_queries
+            )
             return PPORLBatch(
                 query_tensors=queries,
                 response_tensors=responses,

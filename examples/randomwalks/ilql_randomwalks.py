@@ -1,0 +1,79 @@
+"""ILQL on the randomwalks task (parity with reference
+examples/randomwalks/ilql_randomwalks.py: offline RL from pre-generated
+walks labeled with optimality rewards)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.randomwalks import generate_random_walks
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.trainer.ilql_trainer import ILQLConfig
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=11,
+        epochs=20,
+        total_steps=1000,
+        batch_size=100,
+        checkpoint_interval=1000,
+        eval_interval=16,
+        pipeline="PromptPipeline",
+        trainer="ILQLTrainer",
+        tracker=None,
+        checkpoint_dir="/tmp/trlx_tpu_ckpts/ilql_randomwalks",
+    ),
+    model=ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1),
+    tokenizer=TokenizerConfig(tokenizer_path="char:abcdefghijklmnopqrstu"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=2.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=2.0e-4)),
+    method=ILQLConfig(
+        name="ILQLConfig",
+        tau=0.8,
+        gamma=0.99,
+        cql_scale=0.1,
+        awac_scale=1,
+        alpha=0.1,
+        beta=0,
+        steps_for_target_q_sync=5,
+        two_qs=True,
+        gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=1.0, temperature=1.0),
+    ),
+    parallel=ParallelConfig(),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    metric_fn, eval_prompts, walks, *_ = generate_random_walks(seed=config.train.seed)
+    rewards = metric_fn(walks)["optimality"]
+    # split each walk into (starting state, rest of the walk) — the ILQL
+    # dialogue format (reference ilql_randomwalks.py:22-23)
+    walks = [[walk[:1], walk[1:]] for walk in walks]
+
+    return trlx.train(
+        samples=walks,
+        rewards=rewards,
+        eval_prompts=eval_prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

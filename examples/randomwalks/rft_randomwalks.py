@@ -1,0 +1,70 @@
+"""RFT (rejection-sampling fine-tuning) on the randomwalks task (parity
+with reference examples/randomwalks/rft_randomwalks.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.randomwalks import generate_random_walks
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.trainer.rft_trainer import RFTConfig
+
+default_config = TRLConfig(
+    train=TrainConfig(
+        seq_length=10,
+        epochs=100,
+        total_steps=1000,
+        batch_size=100,
+        checkpoint_interval=1000,
+        eval_interval=100,
+        pipeline="PromptPipeline",
+        trainer="RFTTrainer",
+        tracker=None,
+        checkpoint_dir="/tmp/trlx_tpu_ckpts/rft_randomwalks",
+    ),
+    model=ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1),
+    tokenizer=TokenizerConfig(tokenizer_path="char:abcdefghijklmnopqrstu"),
+    optimizer=OptimizerConfig(
+        name="adamw", kwargs=dict(lr=3.0e-4, betas=(0.9, 0.99), eps=1.0e-8, weight_decay=0)
+    ),
+    scheduler=SchedulerConfig(name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)),
+    method=RFTConfig(
+        name="RFTConfig",
+        n_generations_per_prompt=100,
+        start_percentile=0.9,
+        end_percentile=0.95,
+        n_improve_steps=1,
+        gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, temperature=1.0, do_sample=True),
+    ),
+    parallel=ParallelConfig(),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    metric_fn, prompts, *_ = generate_random_walks(seed=config.train.seed)
+
+    return trlx.train(
+        reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],
+        prompts=prompts,
+        eval_prompts=prompts,
+        metric_fn=lambda samples, **kwargs: metric_fn(samples),
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,94 @@
+"""Sentiments task family (parity with the reference's IMDB sentiments
+examples: ppo/ilql/sft/rft/dense/t5/peft/llama variants,
+examples/*sentiments*.py).
+
+The reference scores rollouts with lvwerra/distilbert-imdb on GPU; this
+environment has no network egress, so the default reward is an offline
+lexicon sentiment scorer over the generated text and the default models
+are from-scratch presets with the byte tokenizer. Point
+TRLX_TPU_MODEL_DIR at a local HF checkpoint directory (e.g. a downloaded
+gpt2) to run the real-model configuration — the examples pick it up
+automatically, matching the reference's model_path semantics.
+"""
+
+import os
+from typing import Dict, List
+
+import numpy as np
+
+POSITIVE = (
+    "good great excellent wonderful best love loved amazing beautiful enjoy "
+    "enjoyed fantastic brilliant perfect happy fun delight superb masterpiece"
+).split()
+NEGATIVE = (
+    "bad worst terrible awful hate hated boring poor horrible disappointing "
+    "waste dull mess ugly annoying stupid fail failed unwatchable"
+).split()
+
+PROMPTS = [
+    "This movie was",
+    "The acting in this film",
+    "I watched it twice because",
+    "The plot of the movie",
+    "My favorite scene",
+    "The director clearly",
+    "Compared to the book",
+    "The soundtrack",
+]
+
+
+def sentiment_score(text: str) -> float:
+    """Lexicon positivity in [-1, 1]: (pos - neg) / (pos + neg + 1)."""
+    words = text.lower().split()
+    pos = sum(w.strip(".,!?") in POSITIVE for w in words)
+    neg = sum(w.strip(".,!?") in NEGATIVE for w in words)
+    return (pos - neg) / (pos + neg + 1)
+
+
+def reward_fn(samples: List[str], **kwargs) -> List[float]:
+    return [sentiment_score(s) for s in samples]
+
+
+def dense_reward_fn(samples: List[str], tokenizer=None, **kwargs) -> List[np.ndarray]:
+    """Per-token rewards (reference ppo_dense_sentiments.py): the sentiment
+    score of each growing prefix, differenced so the return telescopes to
+    the full-sample score."""
+    out = []
+    for s in samples:
+        toks = tokenizer.encode(s, add_special_tokens=False) if tokenizer else list(s)
+        n = max(len(toks), 1)
+        prefix_scores = []
+        for i in range(1, n + 1):
+            prefix = tokenizer.decode(toks[:i]) if tokenizer else s[:i]
+            prefix_scores.append(sentiment_score(prefix))
+        dense = np.diff([0.0] + prefix_scores).astype(np.float32)
+        out.append(dense)
+    return out
+
+
+def metric_fn(samples: List[str], **kwargs) -> Dict[str, List[float]]:
+    return {"sentiment": [sentiment_score(s) for s in samples]}
+
+
+def offline_samples(n: int = 256, seed: int = 0):
+    """(samples, rewards) for ILQL: synthetic reviews of mixed polarity."""
+    rng = np.random.default_rng(seed)
+    samples, rewards = [], []
+    for _ in range(n):
+        prompt = PROMPTS[rng.integers(len(PROMPTS))]
+        k = int(rng.integers(2, 6))
+        lexicon = POSITIVE if rng.random() < 0.5 else NEGATIVE
+        words = [lexicon[rng.integers(len(lexicon))] for _ in range(k)]
+        text = prompt + " " + " ".join(words)
+        samples.append([prompt, text[len(prompt):]])
+        rewards.append(sentiment_score(text))
+    return samples, rewards
+
+
+def default_model_and_tokenizer():
+    """(model_path, tokenizer_path): a local HF dir when provided, else the
+    offline-safe from-scratch preset."""
+    local = os.environ.get("TRLX_TPU_MODEL_DIR")
+    if local and os.path.isdir(local):
+        return local, local
+    return "random:gpt2-tiny", "byte"

@@ -1,0 +1,39 @@
+"""ILQL with a T5-style seq2seq model (parity with reference
+examples/ilql_sentiments_t5.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.sentiments import PROMPTS, metric_fn, offline_samples
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+default_config = default_ilql_config().evolve(
+    model=dict(model_path="random:t5-tiny", model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path="byte"),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ilql_sentiments_t5"),
+    method=dict(gen_kwargs=dict(max_new_tokens=24, top_k=20, beta=1.0, temperature=1.0)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    samples, rewards = offline_samples(n=256, seed=config.train.seed)
+    return trlx.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=PROMPTS,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

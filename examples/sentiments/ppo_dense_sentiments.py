@@ -1,0 +1,43 @@
+"""Dense per-token-reward PPO (parity with reference
+examples/ppo_dense_sentiments.py: reward_fn returns a vector of per-token
+scores instead of one scalar; the PPO trainer spreads them over the
+response tokens)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.sentiments import PROMPTS, default_model_and_tokenizer, dense_reward_fn, metric_fn
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+model_path, tokenizer_path = default_model_and_tokenizer()
+
+default_config = default_ppo_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_dense_sentiments"),
+    method=dict(num_rollouts=64, chunk_size=32,
+                gen_kwargs=dict(max_new_tokens=24, top_k=0, top_p=1.0, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    return trlx.train(
+        reward_fn=dense_reward_fn,
+        prompts=PROMPTS * 8,
+        eval_prompts=PROMPTS,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,48 @@
+"""RFT sentiments (parity with reference examples/rft_sentiments.py:
+rejection-sampling fine-tuning against the sentiment reward)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.sentiments import PROMPTS, default_model_and_tokenizer, metric_fn, reward_fn
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+from trlx_tpu.trainer.rft_trainer import RFTConfig
+
+model_path, tokenizer_path = default_model_and_tokenizer()
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, trainer="RFTTrainer",
+               tracker=None, checkpoint_dir="/tmp/trlx_tpu_ckpts/rft_sentiments"),
+)
+default_config.method = RFTConfig(
+    name="RFTConfig",
+    n_generations_per_prompt=16,
+    start_percentile=0.7,
+    end_percentile=0.95,
+    n_improve_steps=2,
+    gen_kwargs=dict(max_new_tokens=24, top_k=0, top_p=1.0, do_sample=True),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=PROMPTS * 4,
+        eval_prompts=PROMPTS,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

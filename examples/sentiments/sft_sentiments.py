@@ -1,0 +1,42 @@
+"""SFT sentiments (parity with reference examples/sft_sentiments.py:
+supervised fine-tuning on the positive samples only)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.sentiments import PROMPTS, default_model_and_tokenizer, metric_fn, offline_samples
+from trlx_tpu.data.default_configs import default_sft_config
+from trlx_tpu.data.configs import TRLConfig
+
+model_path, tokenizer_path = default_model_and_tokenizer()
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/sft_sentiments"),
+    method=dict(gen_kwargs=dict(max_new_tokens=24, top_k=0, top_p=1.0, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    samples, rewards = offline_samples(n=256, seed=config.train.seed)
+    # keep the top-half (positive) samples, flattened to full strings
+    keep = [s[0] + s[1] for s, r in zip(samples, rewards) if r > 0]
+    return trlx.train(
+        samples=keep,
+        eval_prompts=PROMPTS,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,52 @@
+"""Smoke-run every example's main() with tiny overrides — the capability
+surface of SURVEY.md §2.8 (randomwalks + sentiments suites) actually
+executes end-to-end on the CPU mesh."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, REPO)
+
+TINY = {
+    "train.total_steps": 2,
+    "train.batch_size": 4,
+    "train.seq_length": 32,
+    "train.eval_interval": 10,
+    "train.checkpoint_interval": 100,
+    "method.gen_kwargs.max_new_tokens": 4,
+}
+TINY_PPO = {**TINY, "method.num_rollouts": 4, "method.chunk_size": 4, "method.ppo_epochs": 1}
+TINY_RFT = {
+    **TINY,
+    "method.n_generations_per_prompt": 2,
+    "method.n_improve_steps": 1,
+    "method.start_percentile": 0.5,
+    "method.end_percentile": 0.9,
+}
+
+EXAMPLES = [
+    ("examples.randomwalks.ppo_randomwalks", {**TINY_PPO, "train.seq_length": 10}),
+    ("examples.randomwalks.ilql_randomwalks", {**TINY, "train.seq_length": 11}),
+    ("examples.randomwalks.rft_randomwalks", {**TINY_RFT, "train.seq_length": 10}),
+    ("examples.sentiments.ppo_sentiments", TINY_PPO),
+    ("examples.sentiments.ppo_dense_sentiments", TINY_PPO),
+    ("examples.sentiments.ppo_sentiments_peft", TINY_PPO),
+    ("examples.sentiments.ppo_sentiments_t5", TINY_PPO),
+    ("examples.sentiments.ppo_sentiments_llama", TINY_PPO),
+    ("examples.sentiments.ilql_sentiments", TINY),
+    ("examples.sentiments.ilql_sentiments_t5", TINY),
+    ("examples.sentiments.sft_sentiments", TINY),
+    ("examples.sentiments.rft_sentiments", TINY_RFT),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("module_name,hparams", EXAMPLES, ids=[m for m, _ in EXAMPLES])
+def test_example_runs(module_name, hparams):
+    module = importlib.import_module(module_name)
+    trainer = module.main(dict(hparams))
+    assert trainer is not None

@@ -1,0 +1,133 @@
+"""MiniBatchIterator + config-system parity tests (reference
+tests/test_minibatch.py and tests/test_configs.py)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import (
+    default_ilql_config,
+    default_ppo_config,
+    default_sft_config,
+)
+from trlx_tpu.pipeline import DataLoader, MiniBatchIterator, slice_tree, tree_batch_size
+
+
+@dataclasses.dataclass
+class DummyBatch:
+    x: np.ndarray
+    y: np.ndarray
+
+
+class _ListDataset:
+    def __init__(self, n):
+        self.items = [
+            DummyBatch(np.full((3,), i, np.float32), np.asarray(i, np.int64))
+            for i in range(n)
+        ]
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+    def __len__(self):
+        return len(self.items)
+
+
+def _collate(items):
+    return DummyBatch(
+        x=np.stack([i.x for i in items]), y=np.stack([i.y for i in items])
+    )
+
+
+def _loader(n, batch_size):
+    return DataLoader(_ListDataset(n), batch_size, collate_fn=_collate)
+
+
+def test_even_minibatches():
+    loader = _loader(12, 6)
+    mbs = list(MiniBatchIterator(loader, mb_size=2, num_mb=3))
+    assert len(mbs) == 2  # two dataloader batches
+    for minibatch in mbs:
+        assert len(minibatch) == 3
+        for mb in minibatch:
+            assert tree_batch_size(mb) == 2
+            assert isinstance(mb, DummyBatch)  # dataclass type preserved
+    # values cover the dataset exactly once
+    seen = sorted(
+        int(v) for minibatch in mbs for mb in minibatch for v in np.asarray(mb.y).ravel()
+    )
+    assert seen == list(range(12))
+
+
+def test_ragged_tail():
+    """Last dataloader batch smaller than mb_size*num_mb: iterator yields
+    fewer/smaller microbatches, never empty ones (reference warns + skips,
+    pipeline/__init__.py:150-166)."""
+    loader = _loader(10, 6)  # batches of 6 and 4
+    mbs = list(MiniBatchIterator(loader, mb_size=2, num_mb=3))
+    assert len(mbs) == 2
+    assert [tree_batch_size(m) for m in mbs[0]] == [2, 2, 2]
+    assert [tree_batch_size(m) for m in mbs[1]] == [2, 2]
+    for minibatch in mbs:
+        for mb in minibatch:
+            assert tree_batch_size(mb) > 0
+
+
+def test_slice_tree_on_dict():
+    batch = {"a": np.arange(8).reshape(8, 1), "meta": [f"s{i}" for i in range(8)]}
+    part = slice_tree(batch, 2, 4)
+    assert part["a"].tolist() == [[2], [3]]
+    assert part["meta"] == ["s2", "s3"]
+
+
+# ---------------------------------------------------------------------------
+# Config system (reference tests/test_configs.py)
+# ---------------------------------------------------------------------------
+
+
+def test_default_configs_round_trip():
+    for make in (default_ppo_config, default_ilql_config, default_sft_config):
+        config = make()
+        d = config.to_dict()
+        rebuilt = TRLConfig.from_dict(d)
+        assert rebuilt.to_dict() == d
+
+
+def test_yaml_round_trip(tmp_path):
+    import yaml
+
+    config = default_ppo_config()
+    path = tmp_path / "config.yml"
+    with open(path, "w") as f:
+        yaml.safe_dump(config.to_dict(), f)
+    with open(path) as f:
+        loaded = TRLConfig.from_dict(yaml.safe_load(f))
+    assert loaded.method.ppo_epochs == config.method.ppo_epochs
+    assert loaded.train.batch_size == config.train.batch_size
+
+
+def test_dotted_update_and_unknown_keys():
+    config = default_ppo_config()
+    updated = TRLConfig.update(config.to_dict(), {
+        "method.gamma": 0.5,
+        "train.batch_size": 7,
+        "method.gen_kwargs.temperature": 0.3,  # open-ended dict accepts new keys
+    })
+    assert updated.method.gamma == 0.5
+    assert updated.train.batch_size == 7
+    assert updated.method.gen_kwargs["temperature"] == 0.3
+
+    with pytest.raises(ValueError):
+        TRLConfig.update(default_ppo_config().to_dict(), {"train.batch_sz": 1})
+    with pytest.raises(ValueError):
+        TRLConfig.update(default_ppo_config().to_dict(), {"nonsense": 1})
+
+
+def test_evolve_does_not_mutate_base():
+    base = default_ppo_config()
+    before = base.train.batch_size
+    child = base.evolve(train=dict(batch_size=before + 1))
+    assert base.train.batch_size == before
+    assert child.train.batch_size == before + 1

@@ -1,0 +1,108 @@
+"""Sweep runner + curve-comparison harness (reference trlx/sweep.py and
+trlx/reference.py + scripts/benchmark.sh equivalents)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from trlx_tpu.reference import compare_runs, load_runs, source_hash, summarize_curve
+from trlx_tpu.sweep import enumerate_grid, read_metric, sample_strategy, sample_trials
+
+
+def test_sample_strategies():
+    rng = np.random.default_rng(0)
+    assert 1.0 <= sample_strategy({"strategy": "uniform", "values": [1, 2]}, rng) <= 2.0
+    v = sample_strategy({"strategy": "loguniform", "values": [1e-5, 1e-1]}, rng)
+    assert 1e-5 <= v <= 1e-1
+    v = sample_strategy({"strategy": "quniform", "values": [0, 1, 0.25]}, rng)
+    assert v in (0.0, 0.25, 0.5, 0.75, 1.0)
+    assert sample_strategy({"strategy": "choice", "values": ["a", "b"]}, rng) in ("a", "b")
+    assert isinstance(sample_strategy({"strategy": "randint", "values": [1, 10]}, rng), int)
+    with pytest.raises(ValueError):
+        sample_strategy({"strategy": "nope", "values": []}, rng)
+
+
+def test_grid_and_random_trials():
+    space = {
+        "a": {"strategy": "grid", "values": [1, 2]},
+        "b": {"strategy": "grid", "values": ["x", "y", "z"]},
+    }
+    grid = sample_trials(space, "grid", num_samples=0)
+    assert len(grid) == 6
+    assert {"a": 1, "b": "x"} in grid
+
+    rand = sample_trials(
+        {"a": {"strategy": "uniform", "values": [0, 1]}}, "random", num_samples=5, seed=1
+    )
+    assert len(rand) == 5
+    # deterministic under the same seed
+    assert rand == sample_trials(
+        {"a": {"strategy": "uniform", "values": [0, 1]}}, "random", num_samples=5, seed=1
+    )
+
+
+def _write_run(d, name, rows):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"{name}.metrics.jsonl"), "w") as f:
+        for step, vals in rows:
+            f.write(json.dumps({"_step": step, **vals}) + "\n")
+
+
+def test_read_metric(tmp_path):
+    d = str(tmp_path / "trial")
+    _write_run(d, "run", [(0, {"reward/mean": 1.0}), (1, {"reward/mean": 3.0}), (2, {"reward/mean": 2.0})])
+    assert read_metric(d, "reward/mean", "max") == 3.0
+    assert read_metric(d, "reward/mean", "min") == 1.0
+    assert read_metric(d, "missing", "max") == float("-inf")
+
+
+def test_compare_runs(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    _write_run(a, "run", [(i, {"reward/mean": 0.1 * i}) for i in range(10)])
+    _write_run(b, "run", [(i, {"reward/mean": 0.05 * i}) for i in range(10)])
+    report = compare_runs(a, b)
+    assert "reward/mean" in report
+    r = report["reward/mean"]
+    assert r["candidate"]["final"] == pytest.approx(0.9)
+    assert r["delta_final"] == pytest.approx(0.45)
+    s = summarize_curve(load_runs(a)["reward/mean"])
+    assert s["n_points"] == 10 and s["best"] == pytest.approx(0.9)
+
+
+def test_source_hash_stable_and_sensitive(tmp_path):
+    h1 = source_hash()
+    assert h1 == source_hash()
+    assert len(h1) == 16
+    # different tree -> different hash
+    (tmp_path / "x.py").write_text("a = 1\n")
+    assert source_hash(str(tmp_path)) != h1
+
+
+@pytest.mark.slow
+def test_sweep_end_to_end(tmp_path):
+    """One-trial grid sweep over ppo_randomwalks in a subprocess — the full
+    CLI path (script argv contract, JSONL harvest, ranking)."""
+    from trlx_tpu.sweep import run_sweep
+
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    script = os.path.join(repo, "examples", "randomwalks", "ppo_randomwalks.py")
+    config = {
+        "tune_config": {"mode": "max", "metric": "reward/mean", "search_alg": "grid"},
+        "train.total_steps": {"strategy": "grid", "values": [1]},
+        "train.batch_size": {"strategy": "grid", "values": [4]},
+        "method.num_rollouts": {"strategy": "grid", "values": [4]},
+        "method.chunk_size": {"strategy": "grid", "values": [4]},
+        "method.ppo_epochs": {"strategy": "grid", "values": [1]},
+        "method.gen_kwargs.max_new_tokens": {"strategy": "grid", "values": [4]},
+    }
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    summary = run_sweep(script, config, output_dir=str(tmp_path), seed=0, env=env)
+    assert summary["best"] is not None
+    assert summary["best"]["returncode"] == 0, "trial subprocess failed"
+    assert np.isfinite(summary["best"]["reward/mean"])

@@ -19,14 +19,16 @@ from trlx_tpu.data.method_configs import MethodConfig, get_method
 
 
 def merge(base: Dict, update: Dict, updated: Set) -> Dict:
-    """Recursively update a nested dict in place, recording touched keys."""
-    for k, v in base.items():
-        if k in update and isinstance(v, dict):
-            base[k] = merge(v, update[k], updated)
-            updated.add(k)
-        elif k in update:
-            base[k] = update[k]
-            updated.add(k)
+    """Recursively update a nested dict in place, recording touched keys.
+    Keys novel to `base` are added too — validation of unknown paths
+    happens before the merge (TRLConfig.update), and open-ended dicts
+    (gen_kwargs etc.) legitimately accept new keys the defaults lack."""
+    for k, v in update.items():
+        if k in base and isinstance(base[k], dict) and isinstance(v, dict):
+            base[k] = merge(base[k], v, updated)
+        else:
+            base[k] = v
+        updated.add(k)
     return base
 
 

@@ -162,6 +162,15 @@ def slice_tree(batch, start: int, stop: int):
 
 
 def tree_batch_size(batch) -> int:
+    # Plain dataclasses aren't registered pytrees — recurse into fields so
+    # user-defined batch containers work (the reference sizes batches the
+    # same way, pipeline/__init__.py:118-130).
+    if hasattr(batch, "__dataclass_fields__") and not hasattr(batch, "shape"):
+        for f in batch.__dataclass_fields__:
+            n = tree_batch_size(getattr(batch, f))
+            if n:
+                return n
+        return 0
     leaves = jax.tree_util.tree_leaves(batch)
     for leaf in leaves:
         if hasattr(leaf, "shape") and len(getattr(leaf, "shape", ())) > 0:

@@ -1,0 +1,178 @@
+"""Benchmark/curve-comparison harness.
+
+Parity: `python -m trlx.reference fork:branch` + scripts/benchmark.sh. The
+reference runs a fixed benchmark suite on two git branches, tags W&B runs
+with a content hash of the source tree (benchmark.sh:33), and assembles a
+W&B report charting both branches' metric curves side by side
+(reference.py:1-103). TPU-native rebuild, offline-first: runs are JSONL
+logging dirs produced by the builtin tracker; this tool aligns the metric
+curves of two runs, computes final/best/area deltas per metric, prints a
+table and writes a JSON verdict. `source_hash()` gives the same
+content-hash tagging so a run dir can be associated with the exact tree
+that produced it.
+
+Usage:
+    python -m trlx_tpu.reference logs/candidate --against logs/main
+    python -m trlx_tpu.reference --hash-only    # print the tree hash
+"""
+
+import argparse
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def source_hash(root: Optional[str] = None) -> str:
+    """Content hash of the package source tree (the reference hashes
+    `trlx/**/*.py` into the W&B tag, scripts/benchmark.sh:33)."""
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    # iterate os.walk lazily — pruning via dirnames[:] only works before
+    # the generator advances, so no sorted() around the walk itself
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                path = os.path.join(dirpath, fname)
+                h.update(os.path.relpath(path, root).encode())
+                with open(path, "rb") as f:
+                    h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def load_runs(logging_dir: str) -> Dict[str, List[Tuple[int, float]]]:
+    """Merge every *.metrics.jsonl under a logging dir into
+    {metric: [(step, value), ...]} sorted by step."""
+    curves: Dict[str, List[Tuple[int, float]]] = {}
+    if not os.path.isdir(logging_dir):
+        raise FileNotFoundError(f"No such logging dir: {logging_dir}")
+    for dirpath, _, filenames in os.walk(logging_dir):
+        for fname in filenames:
+            if not fname.endswith(".metrics.jsonl"):
+                continue
+            with open(os.path.join(dirpath, fname)) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    step = int(row.get("_step", 0))
+                    for k, v in row.items():
+                        if k.startswith("_"):
+                            continue
+                        try:
+                            curves.setdefault(k, []).append((step, float(v)))
+                        except (TypeError, ValueError):
+                            continue
+    for k in curves:
+        curves[k].sort()
+    return curves
+
+
+def summarize_curve(curve: List[Tuple[int, float]]) -> Dict[str, float]:
+    values = np.asarray([v for _, v in curve], dtype=np.float64)
+    tail = values[int(len(values) * 0.75):] if len(values) > 3 else values
+    return {
+        "final": float(values[-1]),
+        "best": float(values.max()),
+        "mean_last_quarter": float(tail.mean()),
+        "auc": float(values.mean()),
+        "n_points": len(values),
+    }
+
+
+def compare_runs(
+    candidate_dir: str, reference_dir: str, metrics: Optional[List[str]] = None
+) -> Dict[str, Dict]:
+    """Per-metric summary deltas (candidate - reference)."""
+    cand = load_runs(candidate_dir)
+    ref = load_runs(reference_dir)
+    shared = sorted(set(cand) & set(ref))
+    if metrics:
+        shared = [m for m in shared if m in metrics]
+    report = {}
+    for m in shared:
+        cs, rs = summarize_curve(cand[m]), summarize_curve(ref[m])
+        report[m] = {
+            "candidate": cs,
+            "reference": rs,
+            "delta_final": cs["final"] - rs["final"],
+            "delta_best": cs["best"] - rs["best"],
+            "delta_mean_last_quarter": cs["mean_last_quarter"] - rs["mean_last_quarter"],
+        }
+    return report
+
+
+def print_report(report: Dict[str, Dict], key_metrics: Optional[List[str]] = None):
+    rows = []
+    order = key_metrics or sorted(report)
+    for m in order:
+        if m not in report:
+            continue
+        r = report[m]
+        rows.append((
+            m,
+            f"{r['reference']['final']:.5g}",
+            f"{r['candidate']['final']:.5g}",
+            f"{r['delta_final']:+.5g}",
+            f"{r['delta_mean_last_quarter']:+.5g}",
+        ))
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(
+            "metric", "ref final", "cand final", "Δ final", "Δ mean(last 25%)",
+            title="Run comparison",
+        )
+        for row in rows:
+            table.add_row(*row)
+        Console().print(table)
+    except ImportError:
+        for row in rows:
+            logger.info(" | ".join(row))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Compare two JSONL metric runs (reference: python -m trlx.reference)"
+    )
+    parser.add_argument("candidate", type=str, nargs="?", help="Candidate logging dir")
+    parser.add_argument("--against", type=str, help="Reference logging dir")
+    parser.add_argument("--metrics", type=str, nargs="*", default=None,
+                        help="Restrict the report to these metric keys")
+    parser.add_argument("--output", type=str, default=None, help="Write JSON verdict here")
+    parser.add_argument("--hash-only", action="store_true",
+                        help="Print the source tree content hash and exit")
+    args = parser.parse_args()
+
+    if args.hash_only:
+        print(source_hash())
+        return
+
+    if not args.candidate or not args.against:
+        parser.error("candidate and --against logging dirs are required")
+
+    report = compare_runs(args.candidate, args.against, args.metrics)
+    print_report(report, args.metrics)
+    verdict = {
+        "candidate": args.candidate,
+        "reference": args.against,
+        "source_hash": source_hash(),
+        "metrics": report,
+    }
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(verdict, f, indent=2)
+        logger.info(f"Wrote verdict to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,235 @@
+"""Hyperparameter sweeps over dotted-key param spaces.
+
+Parity: `python -m trlx.sweep --config configs/sweeps/ppo_sweep.yml
+examples/ppo_sentiments.py` (reference trlx/sweep.py). The reference builds
+a Ray Tune search space from a yaml file ({strategy, values} per dotted
+config key, sweep.py:17-100) and fans trials out over GPU workers with
+results reported to W&B. TPU-native rebuild: same yaml contract, but trials
+run as local subprocesses (one after another — a TPU chip/slice is a single
+exclusive device, so worker-parallel trials would just contend), each trial
+invokes the example script with a JSON hparams argv (the same contract the
+reference examples use: `json.loads(sys.argv[1])`), metrics land in JSONL
+via the builtin tracker, and the sweep ends with a ranked table +
+sweep_results.json instead of a W&B report.
+
+Usage:
+    python -m trlx_tpu.sweep --config sweep.yml examples/randomwalks/ppo_randomwalks.py
+
+sweep.yml:
+    tune_config:
+        mode: max
+        metric: reward/mean
+        search_alg: random        # random | grid
+        num_samples: 8            # trials (ignored for grid)
+    method.init_kl_coef:
+        strategy: loguniform
+        values: [0.0001, 0.1]
+    optimizer.kwargs.lr:
+        strategy: choice
+        values: [1.0e-4, 3.0e-4]
+"""
+
+import argparse
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+import yaml
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Param space (reference sweep.py:17-100, sans the q* quantized variants'
+# ray objects — sampling happens right here)
+# ---------------------------------------------------------------------------
+
+
+def sample_strategy(value: Dict[str, Any], rng: np.random.Generator):
+    strategy, values = value["strategy"], value["values"]
+    if strategy == "uniform":
+        return float(rng.uniform(values[0], values[1]))
+    if strategy == "quniform":
+        lo, hi, q = values
+        return float(np.round(rng.uniform(lo, hi) / q) * q)
+    if strategy == "loguniform":
+        lo, hi = values[:2]
+        return float(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+    if strategy == "qloguniform":
+        lo, hi, q = values[:3]
+        return float(np.round(np.exp(rng.uniform(np.log(lo), np.log(hi))) / q) * q)
+    if strategy == "randn":
+        mean, sd = values
+        return float(rng.normal(mean, sd))
+    if strategy == "qrandn":
+        mean, sd, q = values
+        return float(np.round(rng.normal(mean, sd) / q) * q)
+    if strategy == "randint":
+        return int(rng.integers(values[0], values[1]))
+    if strategy == "qrandint":
+        lo, hi, q = values
+        return int(np.round(rng.integers(lo, hi) / q) * q)
+    if strategy == "lograndint":
+        lo, hi = values[:2]
+        return int(np.exp(rng.uniform(np.log(lo), np.log(hi))))
+    if strategy in ("choice", "grid", "grid_search"):
+        return values[rng.integers(len(values))]
+    raise ValueError(f"Unknown search strategy '{strategy}'")
+
+
+def enumerate_grid(param_space: Dict[str, Dict]) -> List[Dict[str, Any]]:
+    """Cartesian product over every key's `values` (grid mode)."""
+    keys = list(param_space)
+    value_lists = [param_space[k]["values"] for k in keys]
+    return [dict(zip(keys, combo)) for combo in itertools.product(*value_lists)]
+
+
+def sample_trials(
+    param_space: Dict[str, Dict], search_alg: str, num_samples: int, seed: int = 0
+) -> List[Dict[str, Any]]:
+    if search_alg in ("grid", "grid_search"):
+        return enumerate_grid(param_space)
+    if search_alg != "random":
+        raise ValueError(
+            f"search_alg '{search_alg}' unsupported (random | grid; the "
+            "reference's bayesopt/bohb need external packages)"
+        )
+    rng = np.random.default_rng(seed)
+    return [
+        {k: sample_strategy(v, rng) for k, v in param_space.items()}
+        for _ in range(num_samples)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Trial execution + metric harvesting
+# ---------------------------------------------------------------------------
+
+
+def read_metric(logging_dir: str, metric: str, mode: str) -> float:
+    """Best (per `mode`) value of `metric` across every JSONL run file in
+    the trial's logging dir."""
+    best = None
+    for fname in os.listdir(logging_dir):
+        if not fname.endswith(".metrics.jsonl"):
+            continue
+        with open(os.path.join(logging_dir, fname)) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if metric in row:
+                    v = float(row[metric])
+                    if best is None or (v > best if mode == "max" else v < best):
+                        best = v
+    return best if best is not None else float("-inf" if mode == "max" else "inf")
+
+
+def run_trial(script: str, hparams: Dict[str, Any], trial_dir: str, env=None) -> int:
+    """One trial = one subprocess (fresh XLA/JAX state, crash isolation —
+    the role Ray workers play in the reference)."""
+    os.makedirs(trial_dir, exist_ok=True)
+    hparams = dict(hparams)
+    hparams["train.logging_dir"] = trial_dir
+    hparams["train.tracker"] = "jsonl"
+    with open(os.path.join(trial_dir, "hparams.json"), "w") as f:
+        json.dump(hparams, f, indent=2)
+    with open(os.path.join(trial_dir, "stdout.log"), "w") as out:
+        proc = subprocess.run(
+            [sys.executable, script, json.dumps(hparams)],
+            stdout=out, stderr=subprocess.STDOUT, env=env,
+        )
+    return proc.returncode
+
+
+def run_sweep(
+    script: str,
+    config: Dict[str, Any],
+    output_dir: str = "sweep_results",
+    seed: int = 0,
+    env: Dict[str, str] = None,
+) -> Dict[str, Any]:
+    tune_config = dict(config.pop("tune_config"))
+    metric = tune_config["metric"]
+    mode = tune_config.get("mode", "max")
+    trials = sample_trials(
+        config,
+        tune_config.get("search_alg", "random"),
+        int(tune_config.get("num_samples", 8)),
+        seed=seed,
+    )
+
+    stamp = time.strftime("%Y%m%d-%H%M%S")
+    sweep_dir = os.path.join(output_dir, f"sweep-{stamp}")
+    os.makedirs(sweep_dir, exist_ok=True)
+    logger.info(f"Sweep: {len(trials)} trials of {script} -> {sweep_dir}")
+
+    results = []
+    for i, hparams in enumerate(trials):
+        trial_dir = os.path.join(sweep_dir, f"trial_{i:03d}")
+        logger.info(f"[trial {i + 1}/{len(trials)}] {hparams}")
+        code = run_trial(script, hparams, trial_dir, env=env)
+        score = read_metric(trial_dir, metric, mode)
+        results.append({
+            "trial": i, "hparams": hparams, "returncode": code, metric: score,
+        })
+        logger.info(f"[trial {i + 1}/{len(trials)}] {metric} = {score}")
+
+    reverse = mode == "max"
+    ranked = sorted(results, key=lambda r: r[metric], reverse=reverse)
+    summary = {
+        "script": script,
+        "metric": metric,
+        "mode": mode,
+        "best": ranked[0] if ranked else None,
+        "results": ranked,
+    }
+    with open(os.path.join(sweep_dir, "sweep_results.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+
+    _print_table(ranked, metric)
+    return summary
+
+
+def _print_table(ranked: List[Dict], metric: str, max_rows: int = 20):
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table("rank", "trial", metric, "hparams", title="Sweep results")
+        for rank, r in enumerate(ranked[:max_rows]):
+            table.add_row(
+                str(rank), str(r["trial"]), f"{r[metric]:.5g}", json.dumps(r["hparams"])
+            )
+        Console().print(table)
+    except ImportError:
+        for rank, r in enumerate(ranked[:max_rows]):
+            logger.info(f"#{rank} trial={r['trial']} {metric}={r[metric]:.5g} {r['hparams']}")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Sweep hyperparameters of an example script "
+        "(reference: python -m trlx.sweep)"
+    )
+    parser.add_argument("script", type=str, help="Path to the example script")
+    parser.add_argument("--config", type=str, required=True, help="Param-space yaml")
+    parser.add_argument("--output-dir", type=str, default="sweep_results")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    with open(args.config) as f:
+        config = yaml.safe_load(f)
+    run_sweep(args.script, config, args.output_dir, args.seed)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+"""Example scripts (capability parity with the reference's examples/ —
+SURVEY.md §2.8). A regular package so it always resolves to this repo even
+when the reference tree is on sys.path (tests/reference_oracle.py)."""

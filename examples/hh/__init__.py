@@ -1,0 +1,110 @@
+"""HH-RLHF example suite (parity with reference examples/hh/: PPO/ILQL/SFT
+on helpful-harmless dialogues, model sizes scaled via CONFIG_NAME, reward
+model served remotely).
+
+Offline-first: prompts/dialogues are a small synthetic helpfulness corpus
+and the default reward is a local heuristic scoring answer helpfulness;
+set TRLX_TPU_REWARD_URL to a RewardModelServer (trlx_tpu/serving.py — the
+reference's Triton role) to score remotely, and TRLX_TPU_MODEL_DIR to a
+local HF checkpoint for real weights.
+"""
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+HELPFUL = (
+    "sure here is how you can help step first because explain detail "
+    "example specifically recommend option course certainly"
+).split()
+UNHELPFUL = (
+    "no cannot wont refuse never unfortunately sorry impossible useless whatever"
+).split()
+
+QUESTIONS = [
+    "Human: How do I bake sourdough bread?\n\nAssistant:",
+    "Human: Can you explain photosynthesis simply?\n\nAssistant:",
+    "Human: What's a good way to learn guitar?\n\nAssistant:",
+    "Human: How should I start investing?\n\nAssistant:",
+    "Human: Why is the sky blue?\n\nAssistant:",
+    "Human: How do I fix a leaky faucet?\n\nAssistant:",
+]
+
+
+def helpfulness_score(text: str) -> float:
+    words = text.lower().split()
+    pos = sum(w.strip(".,!?") in HELPFUL for w in words)
+    neg = sum(w.strip(".,!?") in UNHELPFUL for w in words)
+    return (pos - neg) / (pos + neg + 1)
+
+
+def local_reward_fn(samples: List[str], **kwargs) -> List[float]:
+    return [helpfulness_score(s) for s in samples]
+
+
+def get_reward_fn():
+    """Remote reward when TRLX_TPU_REWARD_URL is set (the reference's
+    TRITON_HOST switch, ppo_hh.py:112-130), local heuristic otherwise."""
+    url = os.environ.get("TRLX_TPU_REWARD_URL")
+    if url:
+        from trlx_tpu.serving import remote_reward_fn
+
+        return remote_reward_fn(url, batch_size=24)
+    return local_reward_fn
+
+
+def dialogues(n: int = 256, seed: int = 0) -> Tuple[List[List[str]], List[float]]:
+    """(dialogue samples, rewards) for offline methods."""
+    rng = np.random.default_rng(seed)
+    out, rewards = [], []
+    for _ in range(n):
+        q = QUESTIONS[rng.integers(len(QUESTIONS))]
+        lexicon = HELPFUL if rng.random() < 0.5 else UNHELPFUL
+        answer = " " + " ".join(lexicon[rng.integers(len(lexicon))] for _ in range(int(rng.integers(3, 8))))
+        out.append([q, answer])
+        rewards.append(helpfulness_score(answer))
+    return out, rewards
+
+
+def apply_size_config(config, config_name: str):
+    """Scale the run by CONFIG_NAME (reference ppo_hh.py:71-107). Sizes map
+    to our presets with mesh shapes that fit a v4-8 / multi-host slice —
+    swap model_path for a local SFT checkpoint dir in production."""
+    if not config_name:
+        return config
+    if config_name == "125M":
+        return config.evolve(
+            model=dict(model_path="random:pythia-160m"),
+            train=dict(batch_size=32, total_steps=1500,
+                       checkpoint_dir="checkpoints/ppo_hh_125M"),
+            method=dict(num_rollouts=128),
+        )
+    if config_name == "1B":
+        return config.evolve(
+            model=dict(model_path="random:pythia-1.4b"),
+            train=dict(batch_size=8, total_steps=2500,
+                       checkpoint_dir="checkpoints/ppo_hh_1B"),
+            optimizer=dict(kwargs=dict(lr=6e-6)),
+            method=dict(chunk_size=16),
+            parallel=dict(fsdp=4),
+        )
+    if config_name == "6B":
+        return config.evolve(
+            model=dict(model_path="random:gptj-6b"),
+            train=dict(batch_size=4, seq_length=512, total_steps=6000,
+                       checkpoint_dir="checkpoints/ppo_hh_6B"),
+            method=dict(chunk_size=16),
+            parallel=dict(fsdp=4, tensor=2),
+        )
+    if config_name == "20B":
+        return config.evolve(
+            model=dict(model_path="random:pythia-6.9b",
+                       model_extra_configs=dict(d_model=6144, n_layers=44, n_heads=64)),
+            train=dict(batch_size=1, seq_length=512, total_steps=8000,
+                       checkpoint_dir="checkpoints/ppo_hh_20B"),
+            optimizer=dict(kwargs=dict(lr=1e-6)),
+            method=dict(num_rollouts=16, chunk_size=4, ppo_epochs=2),
+            parallel=dict(fsdp=8, tensor=4),
+        )
+    raise ValueError(f"Unknown CONFIG_NAME '{config_name}' (125M|1B|6B|20B)")

@@ -1,0 +1,39 @@
+"""ILQL on HH-style dialogues (parity with reference examples/hh/ilql_hh.py:
+offline RL from reward-labeled dialogue turns)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.hh import QUESTIONS, dialogues
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+default_config = default_ilql_config().evolve(
+    model=dict(model_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "random:neox-tiny"),
+    tokenizer=dict(tokenizer_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "byte"),
+    train=dict(seq_length=128, batch_size=8, total_steps=400, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ilql_hh"),
+    method=dict(gen_kwargs=dict(max_new_tokens=32, top_k=20, beta=1.0, temperature=1.0)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    samples, rewards = dialogues(n=256, seed=config.train.seed)
+    return trlx.train(
+        samples=samples,
+        rewards=rewards,
+        eval_prompts=QUESTIONS,
+        config=config,
+        stop_sequences=["Human:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

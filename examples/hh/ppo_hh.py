@@ -1,0 +1,42 @@
+"""PPO on HH-style dialogues (parity with reference examples/hh/ppo_hh.py:
+size-scaled configs via CONFIG_NAME, remote reward model via
+TRLX_TPU_REWARD_URL — the Triton-server role)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.hh import QUESTIONS, apply_size_config, get_reward_fn
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+default_config = default_ppo_config().evolve(
+    model=dict(model_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "random:neox-tiny",
+               num_layers_unfrozen=2),
+    tokenizer=dict(tokenizer_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "byte"),
+    train=dict(seq_length=128, batch_size=8, total_steps=400, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_hh"),
+    method=dict(num_rollouts=64, chunk_size=16,
+                gen_kwargs=dict(max_new_tokens=32, top_k=0, top_p=1.0, do_sample=True)),
+)
+default_config = apply_size_config(default_config, os.environ.get("CONFIG_NAME"))
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    return trlx.train(
+        reward_fn=get_reward_fn(),
+        prompts=QUESTIONS * 16,
+        eval_prompts=QUESTIONS,
+        config=config,
+        stop_sequences=["Human:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

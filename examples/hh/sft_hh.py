@@ -1,0 +1,40 @@
+"""SFT on HH-style dialogues (parity with reference examples/hh/sft_hh.py:
+supervised fine-tuning on the helpful (high-reward) dialogues only)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.hh import QUESTIONS, dialogues
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "random:neox-tiny"),
+    tokenizer=dict(tokenizer_path=os.environ.get("TRLX_TPU_MODEL_DIR") or "byte"),
+    train=dict(seq_length=128, batch_size=8, total_steps=400, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/sft_hh"),
+    method=dict(gen_kwargs=dict(max_new_tokens=32, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    samples, rewards = dialogues(n=256, seed=config.train.seed)
+    # train on the helpful half only, as (prompt, output) dialogue pairs
+    keep = [s for s, r in zip(samples, rewards) if r > 0]
+    return trlx.train(
+        samples=keep,
+        eval_prompts=QUESTIONS,
+        config=config,
+        stop_sequences=["Human:"],
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -63,7 +63,9 @@ def load_reference():
         peft.prepare_model_for_int8_training = peft.prepare_model_for_kbit_training
 
     if REFERENCE_PATH not in sys.path:
-        sys.path.insert(0, REFERENCE_PATH)
+        # append, not insert(0): the reference tree also contains an
+        # `examples` package which must never shadow this repo's
+        sys.path.append(REFERENCE_PATH)
     from trlx.models import modeling_ilql, modeling_ppo  # noqa: E402
 
     return modeling_ppo, modeling_ilql

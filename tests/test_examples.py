@@ -41,6 +41,10 @@ EXAMPLES = [
     ("examples.sentiments.ilql_sentiments_t5", TINY),
     ("examples.sentiments.sft_sentiments", TINY),
     ("examples.sentiments.rft_sentiments", TINY_RFT),
+    ("examples.hh.ppo_hh", TINY_PPO),
+    # HH prompts are ~50 byte-tokens; leave room for the output tokens
+    ("examples.hh.ilql_hh", {**TINY, "train.seq_length": 96}),
+    ("examples.hh.sft_hh", {**TINY, "train.seq_length": 96}),
 ]
 
 

@@ -1,0 +1,90 @@
+"""Reward-model server + client (the reference's Triton reward service
+role, examples/hh/ppo_hh.py:112-130)."""
+
+import numpy as np
+import pytest
+
+from trlx_tpu.serving import RewardModelServer, remote_reward_fn
+
+
+@pytest.fixture
+def server():
+    def reward(samples, prompts=None, outputs=None, **metadata):
+        base = [float(len(s)) for s in samples]
+        if metadata.get("bonus"):
+            base = [b + float(x) for b, x in zip(base, metadata["bonus"])]
+        return base
+
+    srv = RewardModelServer(reward, host="127.0.0.1", port=0)
+    url = srv.start_background()
+    yield url
+    srv.shutdown()
+
+
+def test_round_trip(server):
+    fn = remote_reward_fn(server)
+    scores = fn(["ab", "abcd"], prompts=["a", "a"], outputs=["b", "bcd"])
+    assert scores == [2.0, 4.0]
+
+
+def test_metadata_passthrough(server):
+    fn = remote_reward_fn(server)
+    scores = fn(["ab", "abcd"], bonus=[10, 20])
+    assert scores == [12.0, 24.0]
+
+
+def test_client_side_batching(server):
+    fn = remote_reward_fn(server, batch_size=2)
+    samples = ["x" * i for i in range(1, 8)]
+    assert fn(samples, prompts=["p"] * 7, outputs=["o"] * 7) == [float(i) for i in range(1, 8)]
+
+
+def test_dense_scores_pass_through():
+    def dense_reward(samples, **kw):
+        return [np.asarray([0.1] * len(s), dtype=np.float32) for s in samples]
+
+    srv = RewardModelServer(dense_reward, host="127.0.0.1", port=0)
+    url = srv.start_background()
+    try:
+        fn = remote_reward_fn(url)
+        scores = fn(["ab", "abc"])
+        assert [len(s) for s in scores] == [2, 3]
+    finally:
+        srv.shutdown()
+
+
+def test_server_error_propagates(server):
+    def boom(samples, **kw):
+        raise RuntimeError("reward model fell over")
+
+    srv = RewardModelServer(boom, host="127.0.0.1", port=0)
+    url = srv.start_background()
+    try:
+        with pytest.raises(RuntimeError, match="reward server error"):
+            remote_reward_fn(url)(["a"])
+    finally:
+        srv.shutdown()
+
+
+@pytest.mark.slow
+def test_ppo_with_remote_reward(server, monkeypatch, tmp_path):
+    """Full PPO loop scoring through the HTTP reward service (the hh
+    example's TRLX_TPU_REWARD_URL path)."""
+    import trlx_tpu
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny"),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, total_steps=1, tracker=None,
+                   eval_interval=10, checkpoint_interval=100,
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(num_rollouts=4, chunk_size=4, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+    )
+    trainer = trlx_tpu.train(
+        reward_fn=remote_reward_fn(server),
+        prompts=["hello", "world"] * 2,
+        config=config,
+    )
+    assert trainer is not None

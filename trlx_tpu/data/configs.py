@@ -182,6 +182,23 @@ class TrainConfig:
 
     minibatch_size: Optional[int] = None
 
+    # JAX profiler tracing (SURVEY.md §5.1: the reference only has coarse
+    # time/* metrics + NeMo nsys hooks; here a real trace). When set,
+    # learn() captures steps [profile_start, profile_stop) into
+    # profile_dir for TensorBoard / Perfetto.
+    profile_dir: Optional[str] = None
+    profile_start: int = 2
+    profile_stop: int = 4
+
+    # Fuse each inner epoch's optimizer steps into ONE jitted lax.scan
+    # dispatch (TPU-idiomatic; a torch trainer can't do this). Semantics
+    # are identical — one optimizer update per minibatch — but stats are
+    # averaged over the epoch and logged once, and eval/checkpoint
+    # intervals are checked between epochs rather than between steps.
+    # Ignored when gradient accumulation is on (minibatch_size <
+    # batch_size).
+    fuse_inner_epoch: bool = False
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         return cls(**config)

@@ -125,6 +125,22 @@ class MeshRuntime:
     def replicated(self) -> NamedSharding:
         return self.sharding()
 
+    def shard_batch_stacked(self, batch):
+        """Place a [n_steps, batch, ...] stacked batch pytree: step dim
+        replicated (it feeds lax.scan), batch dim sharded over DP axes."""
+        sharding = self.sharding(None, ("data", "fsdp"))
+        replicated = self.replicated
+        dp = self.dp_size
+
+        def _place(x):
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 2:
+                arr = np.asarray(x)
+                target = sharding if arr.shape[1] % dp == 0 else replicated
+                return jax.device_put(arr, target)
+            return x
+
+        return jax.tree_util.tree_map(_place, batch)
+
     def shard_batch(self, batch):
         """Place a host batch pytree onto the mesh, batch-dim sharded over
         the DP axes. Leaves whose leading dim doesn't divide the DP ways

@@ -1,0 +1,180 @@
+"""Reward-model serving: a remote scoring service + client.
+
+Parity: the reference's HH pipeline scores rollouts against a reward model
+hosted on a separate GPU behind NVIDIA Triton Inference Server, reached
+through a gRPC client (examples/hh/ppo_hh.py:10,112-130,
+examples/hh/triton_config.pbtxt). The TPU-native equivalent keeps the
+pluggable `reward_fn(samples, prompts, outputs, **metadata)` contract and
+swaps the transport for a dependency-free HTTP JSON service: run the
+reward model (a JAX model on its own TPU slice, or any python callable)
+inside `RewardModelServer`, point the trainer at it with
+`remote_reward_fn(url)`.
+
+Server:   python -m trlx_tpu.serving  (toy lexicon reward on :8500)
+          or RewardModelServer(reward_fn, port=8500).serve()
+Client:   trlx.train(reward_fn=remote_reward_fn("http://host:8500"), ...)
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class RewardModelServer:
+    """Serve a reward_fn over HTTP POST /score.
+
+    Request JSON:  {"samples": [...], "prompts": [...], "outputs": [...],
+                    ...metadata}
+    Response JSON: {"scores": [...]} — each score a float or a list of
+    per-token floats (dense rewards pass through unchanged).
+    """
+
+    def __init__(self, reward_fn: Callable, host: str = "0.0.0.0", port: int = 8500):
+        self.reward_fn = reward_fn
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _make_handler(self):
+        reward_fn = self.reward_fn
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/score", "/v2/score"):
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    samples = payload.pop("samples")
+                    scores = reward_fn(samples=samples, **payload)
+                    scores = [
+                        np.asarray(s, dtype=np.float32).tolist() if np.ndim(s) else float(s)
+                        for s in scores
+                    ]
+                    body = json.dumps({"scores": scores}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:  # surface scoring errors to the client
+                    body = json.dumps({"error": repr(e)}).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802  (health check)
+                body = b'{"status": "ok"}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                logger.debug("reward-server: " + fmt % args)
+
+        return Handler
+
+    def start_background(self) -> str:
+        """Start serving on a daemon thread; returns the base URL."""
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        url = f"http://{'127.0.0.1' if self.host == '0.0.0.0' else self.host}:{self.port}"
+        logger.info(f"Reward server listening on {url}")
+        return url
+
+    def serve(self):
+        """Blocking serve (the standalone reward-model process)."""
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        logger.info(f"Reward server listening on :{self.port}")
+        self._httpd.serve_forever()
+
+    def shutdown(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def remote_reward_fn(url: str, timeout: float = 120.0, batch_size: int = 0) -> Callable:
+    """A reward_fn that scores via a RewardModelServer (the reference's
+    triton client round, ppo_hh.py:112-130). Optional client-side
+    batching for large rollout chunks."""
+    import urllib.request
+
+    url = url.rstrip("/") + "/score"
+
+    def call(payload: dict) -> List:
+        import urllib.error
+
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                detail = str(e)
+            raise RuntimeError(f"reward server error: {detail}") from e
+        if "error" in out:
+            raise RuntimeError(f"reward server error: {out['error']}")
+        return out["scores"]
+
+    def reward_fn(samples: List[str], prompts=None, outputs=None, tokenizer=None, **metadata):
+        payload_meta = {
+            k: (np.asarray(v).tolist() if isinstance(v, np.ndarray) else v)
+            for k, v in metadata.items()
+        }
+        base = dict(payload_meta)
+        if prompts is not None:
+            base["prompts"] = list(prompts)
+        if outputs is not None:
+            base["outputs"] = list(outputs)
+
+        if not batch_size or len(samples) <= batch_size:
+            return call({**base, "samples": list(samples)})
+        scores: List = []
+        for i in range(0, len(samples), batch_size):
+            sub = {
+                k: v[i : i + batch_size] if isinstance(v, list) and len(v) == len(samples) else v
+                for k, v in base.items()
+            }
+            scores.extend(call({**sub, "samples": list(samples[i : i + batch_size])}))
+        return scores
+
+    return reward_fn
+
+
+def main():
+    import argparse
+
+    parser = argparse.ArgumentParser(description="Serve a toy reward model")
+    parser.add_argument("--port", type=int, default=8500)
+    args = parser.parse_args()
+
+    def toy_reward(samples, **kwargs):
+        return [float(len(s)) / 100.0 for s in samples]
+
+    RewardModelServer(toy_reward, port=args.port).serve()
+
+
+if __name__ == "__main__":
+    main()

@@ -270,7 +270,27 @@ class TPUTrainer(BaseRLTrainer):
             train_params = optax.apply_updates(train_params, updates)
             return train_params, opt_state
 
+        def train_scan(train_params, frozen_params, opt_state, stacked_batches):
+            """N optimizer steps in one compiled program: lax.scan over the
+            stacked minibatches (one dispatch per inner epoch instead of
+            one per step; the functional analogue has no reference
+            equivalent — torch must step the optimizer from Python)."""
+
+            def body(carry, batch):
+                train_params, opt_state = carry
+                _, stats, grads = grad_fn(train_params, frozen_params, batch)
+                updates, opt_state = optimizer.update(grads, opt_state, train_params)
+                train_params = optax.apply_updates(train_params, updates)
+                return (train_params, opt_state), stats
+
+            (train_params, opt_state), stats = jax.lax.scan(
+                body, (train_params, opt_state), stacked_batches
+            )
+            mean_stats = jax.tree_util.tree_map(lambda s: s.mean(0), stats)
+            return train_params, opt_state, mean_stats
+
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 2))
+        self._train_scan_fn = jax.jit(train_scan, donate_argnums=(0, 2))
         self._accum_fns = (
             jax.jit(accum_step, donate_argnums=(2,)),
             jax.jit(apply_step, donate_argnums=(0, 1, 2)),
@@ -301,6 +321,49 @@ class TPUTrainer(BaseRLTrainer):
         # accelerate_base_trainer.py:580-583)
         return jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *stats_list)
 
+    def train_inner_epoch_fused(self, train_dataloader) -> Tuple[Dict[str, float], int]:
+        """Run one inner epoch's optimizer steps as a single jitted
+        lax.scan dispatch. Returns (epoch-mean stats, n_steps). Batches
+        must be homogeneous in shape; a ragged tail falls back to per-step
+        dispatch."""
+        if self._train_step_fn is None:
+            self._build_steps()
+        batches = [b for mb in MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb)
+                   for b in mb]
+        if not batches:
+            return {}, 0
+        # homogeneous-shape PREFIX goes through the scan; any ragged
+        # remainder (e.g. a smaller final batch) dispatches per step
+        lead_shapes = _batch_shapes(batches[0])
+        n_lead = 0
+        for b in batches:
+            if _batch_shapes(b) != lead_shapes:
+                break
+            n_lead += 1
+        lead, tail = batches[:n_lead], batches[n_lead:]
+
+        all_stats = []  # (stats pytree, weight)
+        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *lead)
+        stacked = self.runtime.shard_batch_stacked(stacked)
+        self.train_params, self.opt_state, stats = self._train_scan_fn(
+            self.train_params, self.frozen_params, self.opt_state, stacked
+        )
+        all_stats.append((stats, len(lead)))
+        for batch in tail:
+            self.train_params, self.opt_state, stats = self._train_step_fn(
+                self.train_params, self.frozen_params, self.opt_state,
+                self.batch_to_device(batch),
+            )
+            all_stats.append((stats, 1))
+        n_steps = len(batches)
+        if len(all_stats) == 1:  # no ragged tail: scan stats are the epoch mean
+            return all_stats[0][0], n_steps
+        mean_stats = jax.tree_util.tree_map(
+            lambda *xs: sum(x * w for x, (_, w) in zip(xs, all_stats)) / n_steps,
+            *[s for s, _ in all_stats],
+        )
+        return mean_stats, n_steps
+
     # ------------------------------------------------------------------
     # Learn / evaluate / checkpoints
     # ------------------------------------------------------------------
@@ -323,78 +386,121 @@ class TPUTrainer(BaseRLTrainer):
         best_reward = -float("inf")
         clock = Clock()
 
+        try:
+            return self._learn_loop(best_reward, clock)
+        finally:
+            if getattr(self, "_profiling", False):
+                jax.profiler.stop_trace()
+                self._profiling = False
+
+    def _learn_loop(self, best_reward, clock):
+        results = {}
+        fuse = self.config.train.fuse_inner_epoch and self.num_mb == 1
         for _ in range(self.config.train.epochs):
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
+                if fuse:
+                    # one jitted lax.scan dispatch for the whole inner epoch
+                    self._maybe_profile_step()
+                    stats, n_steps = self.train_inner_epoch_fused(train_dataloader)
+                    self.iter_count += n_steps
+                    res, best_reward, done = self._post_step(
+                        stats, clock, best_reward, n_steps=n_steps
+                    )
+                    results = res or results
+                    if done:
+                        return results
+                    self.post_backward_callback()
+                    continue
                 for minibatch in MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb):
+                    self._maybe_profile_step()
                     stats = self.train_minibatch(minibatch)
                     self.iter_count += 1
-
-                    if (
-                        self.iter_count % self.config.train.checkpoint_interval == 0
-                        or self.iter_count >= self.total_steps
-                    ):
-                        subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
-                        directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
-                        self.save(directory)
-                        self.save_pretrained(os.path.join(directory, "hf_model"))
-
-                    stats = {
-                        k: float(np.asarray(v)) if np.ndim(v) == 0 else v
-                        for k, v in _flatten_stats(stats).items()
-                    }
-                    stats["time/step"] = clock.tick(self.config.train.batch_size)
-                    stats["learning_rate"] = float(
-                        np.asarray(self.lr_schedule(self.iter_count))
-                    )
-
-                    if (
-                        self.iter_count % self.config.train.eval_interval == 0
-                        or self.iter_count >= self.total_steps
-                    ):
-                        results = self.evaluate()
-                        stats.update(results)
-
-                        if self.config.train.save_best:
-                            current = stats.get(
-                                "reward/mean", stats.get("metrics/reward", -float("inf"))
-                            )
-                            if jax.process_count() > 1:
-                                # rewards exist only on process 0; broadcast so
-                                # every host takes the same save branch (orbax
-                                # save is a collective — skew would deadlock;
-                                # reference all-reduces do_save the same way,
-                                # accelerate_base_trainer.py:621-628)
-                                from jax.experimental import multihost_utils
-
-                                current = float(
-                                    multihost_utils.broadcast_one_to_all(
-                                        np.float32(current)
-                                    )
-                                )
-                            if current > best_reward:
-                                best_reward = current
-                                directory = os.path.join(
-                                    self.config.train.checkpoint_dir, "best_checkpoint"
-                                )
-                                logger.info(f"Saving best checkpoint into {directory}")
-                                self.save(directory)
-                                self.save_pretrained(os.path.join(directory, "hf_model"))
-
-                    self.tracker.log(stats, step=self.iter_count)
-                    loss_desc = " | ".join(
-                        f"{k.split('/')[-1]}: {significant(v)}"
-                        for k, v in stats.items()
-                        if "loss" in k and np.ndim(v) == 0
-                    )
-                    logger.info(f"[step {self.iter_count}/{self.total_steps}] {loss_desc}")
-
-                    if self.iter_count >= self.total_steps:
+                    res, best_reward, done = self._post_step(stats, clock, best_reward)
+                    results = res or results
+                    if done:
                         return results
 
                 self.post_backward_callback()
             self.post_epoch_callback()
         return results
+
+    def _post_step(self, stats, clock, best_reward, n_steps: int = 1):
+        """Checkpoint / stats fetch / eval / best-checkpoint / logging after
+        an optimizer step (or a fused inner epoch of `n_steps` steps).
+        Intervals use crossing semantics so strides > 1 still fire.
+        Returns (eval results, best_reward, done)."""
+        results = {}
+        done = self.iter_count >= self.total_steps
+
+        def crossed(interval: int) -> bool:
+            return self.iter_count // interval > (self.iter_count - n_steps) // interval
+
+        if crossed(self.config.train.checkpoint_interval) or done:
+            subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
+            directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
+            self.save(directory)
+            self.save_pretrained(os.path.join(directory, "hf_model"))
+
+        # one batched device->host fetch for the whole stats dict (per-stat
+        # np.asarray would pay one relay round trip each)
+        stats = jax.device_get(_flatten_stats(stats))
+        stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
+        stats["time/step"] = clock.tick(self.config.train.batch_size * n_steps) / n_steps
+        stats["learning_rate"] = float(np.asarray(self.lr_schedule(self.iter_count)))
+
+        if crossed(self.config.train.eval_interval) or done:
+            results = self.evaluate()
+            stats.update(results)
+
+            if self.config.train.save_best:
+                current = stats.get(
+                    "reward/mean", stats.get("metrics/reward", -float("inf"))
+                )
+                if jax.process_count() > 1:
+                    # rewards exist only on process 0; broadcast so every
+                    # host takes the same save branch (orbax save is a
+                    # collective — skew would deadlock; reference
+                    # all-reduces do_save the same way,
+                    # accelerate_base_trainer.py:621-628)
+                    from jax.experimental import multihost_utils
+
+                    current = float(
+                        multihost_utils.broadcast_one_to_all(np.float32(current))
+                    )
+                if current > best_reward:
+                    best_reward = current
+                    directory = os.path.join(
+                        self.config.train.checkpoint_dir, "best_checkpoint"
+                    )
+                    logger.info(f"Saving best checkpoint into {directory}")
+                    self.save(directory)
+                    self.save_pretrained(os.path.join(directory, "hf_model"))
+
+        self.tracker.log(stats, step=self.iter_count)
+        loss_desc = " | ".join(
+            f"{k.split('/')[-1]}: {significant(v)}"
+            for k, v in stats.items()
+            if "loss" in k and np.ndim(v) == 0
+        )
+        logger.info(f"[step {self.iter_count}/{self.total_steps}] {loss_desc}")
+        return results, best_reward, done
+
+    def _maybe_profile_step(self):
+        """Capture a jax.profiler trace over the configured step window
+        (train.profile_dir / profile_start / profile_stop)."""
+        cfg = self.config.train
+        if not cfg.profile_dir:
+            return
+        if cfg.profile_start <= self.iter_count < cfg.profile_stop and not getattr(self, "_profiling", False):
+            os.makedirs(cfg.profile_dir, exist_ok=True)
+            logger.info(f"Starting profiler trace into {cfg.profile_dir}")
+            jax.profiler.start_trace(cfg.profile_dir)
+            self._profiling = True
+        elif self.iter_count >= cfg.profile_stop and getattr(self, "_profiling", False):
+            jax.profiler.stop_trace()
+            self._profiling = False
+            logger.info(f"Profiler trace written to {cfg.profile_dir}")
 
     def evaluate(self) -> Dict[str, Any]:
         """Generate on eval prompts, score with reward_fn/metric_fn
@@ -541,6 +647,10 @@ class TPUTrainer(BaseRLTrainer):
                 f.write(serialization.to_bytes(self.params))
         with open(os.path.join(directory, "trlx_tpu_config.json"), "w") as f:
             json.dump(self.config.to_dict(), f, indent=2, default=str)
+
+
+def _batch_shapes(batch) -> Tuple:
+    return tuple(np.shape(x) for x in jax.tree_util.tree_leaves(batch))
 
 
 def _flatten_stats(d: Dict, prefix: str = "") -> Dict:

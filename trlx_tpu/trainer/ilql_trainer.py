@@ -78,20 +78,39 @@ def make_experience(samples, rewards, tokenizer=None, max_length=2048, verbose=T
     all_actions_ixs = []
     all_states_ixs = []
     all_dones = []
-    for sample in samples:
+    kept_rewards = []
+    n_skipped = 0
+    for sample, reward in zip(samples, rewards):
         length = 0
-        all_input_ids.append(np.asarray([t for s in sample for t in s.tokens], dtype=np.int32))
+        input_ids = np.asarray([t for s in sample for t in s.tokens], dtype=np.int32)
         actions_ixs = []
         for dm in sample:
             if dm.is_output:
                 actions_ixs.append(np.arange(length - 1, length + len(dm.tokens) - 1))
             length += len(dm.tokens)
+        if not actions_ixs or sum(len(a) for a in actions_ixs) == 0:
+            # output fully truncated away (prompt >= max_length): no
+            # actions to fit a Q function on — skip the sample
+            n_skipped += 1
+            continue
+        all_input_ids.append(input_ids)
         states_ixs = np.concatenate([*actions_ixs, [length - 1]]).astype(np.int32)
         all_dones.append(np.asarray([1] * (len(states_ixs) - 1) + [0], dtype=np.int32))
         all_actions_ixs.append(np.concatenate(actions_ixs).astype(np.int32))
         all_states_ixs.append(states_ixs)
+        kept_rewards.append(reward)
+    if n_skipped:
+        logger.warning(
+            f"Skipped {n_skipped}/{len(samples)} samples whose outputs were "
+            "entirely truncated (prompt longer than max_length)"
+        )
+    if not all_input_ids:
+        raise ValueError(
+            "No usable samples: every output was truncated away; increase "
+            "train.seq_length or shorten the prompts"
+        )
 
-    rewards_per_sample = _normalized_returns_per_sample(rewards, all_actions_ixs)
+    rewards_per_sample = _normalized_returns_per_sample(kept_rewards, all_actions_ixs)
     attention_mask = [np.ones(len(x), dtype=np.int32) for x in all_input_ids]
 
     return ILQLRolloutStorage(

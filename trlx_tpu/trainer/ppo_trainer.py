@@ -380,11 +380,14 @@ class PPOTrainer(TPUTrainer):
                     self.train_params, self.frozen_params, self.ref_params,
                     jnp.asarray(all_tokens),
                 )
-            logprobs = np.asarray(logprobs)
-            values = np.asarray(values)
-            log_ratio = np.asarray(log_ratio)
-            mean_kl = float(np.asarray(mean_kl))
-            mean_kl_per_token = float(np.asarray(mean_kl_per_token))
+            # ONE batched device->host fetch: sequential np.asarray calls
+            # each pay a full relay round trip (~100ms on tunneled TPU
+            # backends), jax.device_get pipelines them together.
+            logprobs, values, log_ratio, mean_kl, mean_kl_per_token = jax.device_get(
+                (logprobs, values, log_ratio, mean_kl, mean_kl_per_token)
+            )
+            mean_kl = float(mean_kl)
+            mean_kl_per_token = float(mean_kl_per_token)
 
             # Slice per-sample response windows: logprob[i] is the (log)prob
             # with which all_tokens[i+1] was sampled. For seq2seq everything

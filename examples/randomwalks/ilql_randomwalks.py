@@ -49,7 +49,9 @@ default_config = TRLConfig(
         beta=0,
         steps_for_target_q_sync=5,
         two_qs=True,
-        gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=1.0, temperature=1.0),
+        # beta list = eval-time generation sweep (reference
+        # ilql_randomwalks.py gen_kwargs beta=[0, 1, 100])
+        gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=[0, 1, 100], temperature=1.0),
     ),
     parallel=ParallelConfig(),
 )

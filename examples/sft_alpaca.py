@@ -1,0 +1,58 @@
+"""Instruction-tuning SFT (parity with reference examples/alpaca/sft_alpaca.py:
+supervised fine-tuning on instruction/response pairs). Offline-safe synthetic
+instruction data; TRLX_TPU_MODEL_DIR switches to a real checkpoint."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) + "/..")
+
+import numpy as np
+
+import trlx_tpu as trlx
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+TEMPLATE = (
+    "Below is an instruction that describes a task. Write a response that "
+    "appropriately completes the request.\n\n### Instruction:\n{instruction}\n\n### Response:\n"
+)
+
+INSTRUCTIONS = [
+    ("List three colors.", "red green blue"),
+    ("Name two animals.", "cat dog"),
+    ("Count to three.", "one two three"),
+    ("Give a greeting.", "hello there friend"),
+    ("Name a season.", "summer"),
+    ("List two fruits.", "apple banana"),
+]
+
+local = os.environ.get("TRLX_TPU_MODEL_DIR")
+model_path = local if local and os.path.isdir(local) else "random:gpt2-tiny"
+tokenizer_path = local if local and os.path.isdir(local) else "byte"
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=160, batch_size=16, total_steps=300, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/sft_alpaca"),
+    method=dict(gen_kwargs=dict(max_new_tokens=24, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    rng = np.random.default_rng(config.train.seed)
+    samples = []
+    for _ in range(256):
+        inst, resp = INSTRUCTIONS[rng.integers(len(INSTRUCTIONS))]
+        samples.append([TEMPLATE.format(instruction=inst), resp])
+    eval_prompts = [TEMPLATE.format(instruction=i) for i, _ in INSTRUCTIONS]
+    return trlx.train(samples=samples, eval_prompts=eval_prompts, config=config)
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,76 @@
+"""Summarize-RLHF recipe (parity with reference examples/summarize_rlhf/:
+the OpenAI learning-to-summarize pipeline — SFT on TL;DR, reward model on
+human preference pairs, PPO against the RM).
+
+Offline-safe synthetic task: "posts" are generated word sequences; a good
+summary extracts the post's leading keywords, a bad one is unrelated
+words. The three stages share this module:
+
+    python examples/summarize_rlhf/train_sft.py
+    python examples/summarize_rlhf/train_reward_model.py
+    python examples/summarize_rlhf/ppo_summarize.py
+"""
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+VOCAB = (
+    "cat dog house tree river cloud stone bird fish road light music dream "
+    "paper glass stair window garden winter summer morning"
+).split()
+
+TLDR = " TL;DR:"
+
+
+def make_post(rng) -> Tuple[str, str, str]:
+    """(post+TLDR prompt, good summary, bad summary)."""
+    words = [VOCAB[rng.integers(len(VOCAB))] for _ in range(int(rng.integers(8, 16)))]
+    post = " ".join(words)
+    good = " " + " ".join(words[:3])
+    bad_words = [VOCAB[rng.integers(len(VOCAB))] for _ in range(3)]
+    bad = " " + " ".join(bad_words)
+    return post + TLDR, good, bad
+
+
+def sft_samples(n: int = 256, seed: int = 0) -> List[List[str]]:
+    rng = np.random.default_rng(seed)
+    return [list(make_post(rng)[:2]) for _ in range(n)]
+
+
+def preference_pairs(n: int = 256, seed: int = 1):
+    """[(prompt, chosen, rejected)] for RM training."""
+    rng = np.random.default_rng(seed)
+    return [make_post(rng) for _ in range(n)]
+
+
+def prompts(n: int = 64, seed: int = 2) -> List[str]:
+    rng = np.random.default_rng(seed)
+    return [make_post(rng)[0] for _ in range(n)]
+
+
+def summary_overlap_metric(samples: List[str], **kwargs):
+    """Fraction of the post's first-3 keywords recovered in the summary
+    (the task's ground-truth quality signal, used as eval metric_fn)."""
+    scores = []
+    for s in samples:
+        if TLDR in s:
+            post, summary = s.split(TLDR, 1)
+        else:
+            post, summary = s, ""
+        keywords = post.split()[:3]
+        found = sum(k in summary.split() for k in keywords)
+        scores.append(found / max(len(keywords), 1))
+    return {"keyword_overlap": scores}
+
+
+RM_PARAMS_PATH = "/tmp/trlx_tpu_ckpts/summarize_rm/rm_params.msgpack"
+SFT_DIR = "/tmp/trlx_tpu_ckpts/summarize_sft"
+
+
+def default_model_and_tokenizer():
+    local = os.environ.get("TRLX_TPU_MODEL_DIR")
+    if local and os.path.isdir(local):
+        return local, local
+    return "random:gpt2-tiny", "byte"

@@ -1,0 +1,80 @@
+"""Stage 3: PPO against the trained reward model (parity with reference
+examples/summarize_rlhf/trlx_gptj_text_summarization.py). Requires
+train_reward_model.py to have produced RM_PARAMS_PATH (runs it inline with
+tiny settings if missing)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import jax
+import numpy as np
+from flax import serialization
+
+import trlx_tpu as trlx
+from examples.summarize_rlhf import (
+    RM_PARAMS_PATH,
+    default_model_and_tokenizer,
+    prompts,
+    summary_overlap_metric,
+)
+from trlx_tpu.data.configs import ModelConfig, TokenizerConfig, TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.models import resolve_transformer_config
+from trlx_tpu.models.reward import CausalLMWithRewardHead, make_reward_fn
+from trlx_tpu.tokenizers import get_tokenizer
+
+model_path, tokenizer_path = default_model_and_tokenizer()
+
+default_config = default_ppo_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=128, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_summarize"),
+    method=dict(num_rollouts=64, chunk_size=32,
+                gen_kwargs=dict(max_new_tokens=24, top_k=0, top_p=1.0, do_sample=True)),
+)
+
+
+def load_reward_model(rm_hparams=None):
+    if not os.path.exists(RM_PARAMS_PATH):
+        from examples.summarize_rlhf import train_reward_model
+
+        train_reward_model.main(rm_hparams or {})
+
+    tokenizer = get_tokenizer(TokenizerConfig(tokenizer_path=tokenizer_path))
+    cfg = resolve_transformer_config(
+        ModelConfig(model_path=model_path), vocab_size=tokenizer.vocab_size
+    )
+    model = CausalLMWithRewardHead(cfg)
+    import jax.numpy as jnp
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    with open(RM_PARAMS_PATH, "rb") as f:
+        params = serialization.from_bytes(template, f.read())
+    # matches RM training MAX_LEN: the whole sample (post + TL;DR + summary)
+    # must fit so the policy's output is actually scored
+    return make_reward_fn(model, params, tokenizer, max_length=160)
+
+
+def main(hparams={}):
+    hparams = dict(hparams)
+    rm_hparams = hparams.pop("rm", None)
+    config = TRLConfig.update(default_config, hparams)
+    reward_fn = load_reward_model(rm_hparams)
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=prompts(n=64, seed=config.train.seed),
+        eval_prompts=prompts(n=8, seed=config.train.seed + 1),
+        metric_fn=summary_overlap_metric,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

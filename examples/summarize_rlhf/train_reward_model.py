@@ -1,0 +1,97 @@
+"""Stage 2: reward model on preference pairs (parity with reference
+examples/summarize_rlhf/reward_model/train_reward_model_gptj.py — GPT
+trunk + scalar head, pairwise Bradley-Terry loss, accuracy eval)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import serialization
+
+from examples.summarize_rlhf import (
+    RM_PARAMS_PATH,
+    default_model_and_tokenizer,
+    preference_pairs,
+)
+from trlx_tpu.data.configs import ModelConfig
+from trlx_tpu.models import resolve_transformer_config
+from trlx_tpu.models.reward import CausalLMWithRewardHead, pairwise_loss
+from trlx_tpu.tokenizers import get_tokenizer
+from trlx_tpu.data.configs import TokenizerConfig
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+# long enough for the longest post + TL;DR marker + summary, so the
+# summary tail is never truncated out of scoring
+MAX_LEN = 160
+
+
+def encode_batch(tokenizer, texts, max_len=MAX_LEN):
+    enc = tokenizer(list(texts), max_length=max_len, truncation=True, padding="max_length")
+    return enc["input_ids"], enc["attention_mask"]
+
+
+def main(hparams={}):
+    steps = int(hparams.get("steps", 200))
+    batch_size = int(hparams.get("batch_size", 16))
+    lr = float(hparams.get("lr", 1e-4))
+    seed = int(hparams.get("seed", 0))
+
+    model_path, tokenizer_path = default_model_and_tokenizer()
+    tokenizer = get_tokenizer(TokenizerConfig(tokenizer_path=tokenizer_path))
+    cfg = resolve_transformer_config(
+        ModelConfig(model_path=model_path), vocab_size=tokenizer.vocab_size
+    )
+    model = CausalLMWithRewardHead(cfg)
+
+    pairs = preference_pairs(n=512, seed=seed)
+    rng = np.random.default_rng(seed)
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), tokens, jnp.ones_like(tokens))["params"]
+    optimizer = optax.adamw(lr)
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, c_tok, c_mask, r_tok, r_mask):
+        def loss_fn(p):
+            rc = model.apply({"params": p}, c_tok, c_mask)
+            rr = model.apply({"params": p}, r_tok, r_mask)
+            return pairwise_loss(rc, rr)
+
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, stats
+
+    for step in range(steps):
+        idx = rng.integers(0, len(pairs), size=batch_size)
+        chosen = [pairs[i][0] + pairs[i][1] for i in idx]
+        rejected = [pairs[i][0] + pairs[i][2] for i in idx]
+        c_tok, c_mask = encode_batch(tokenizer, chosen)
+        r_tok, r_mask = encode_batch(tokenizer, rejected)
+        params, opt_state, stats = train_step(params, opt_state, c_tok, c_mask, r_tok, r_mask)
+        if step % 50 == 0 or step == steps - 1:
+            stats = jax.device_get(stats)
+            logger.info(
+                f"[rm step {step}/{steps}] loss {float(stats['loss']):.4f} "
+                f"acc {float(stats['accuracy']):.3f}"
+            )
+
+    os.makedirs(os.path.dirname(RM_PARAMS_PATH), exist_ok=True)
+    with open(RM_PARAMS_PATH, "wb") as f:
+        f.write(serialization.to_bytes(jax.device_get(params)))
+    logger.info(f"Saved reward model params to {RM_PARAMS_PATH}")
+    return float(stats["accuracy"])
+
+
+if __name__ == "__main__":
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,45 @@
+"""Stage 1: SFT on (post, summary) pairs (parity with reference
+examples/summarize_rlhf/sft/train_gptj_summarize.py)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.summarize_rlhf import (
+    SFT_DIR,
+    default_model_and_tokenizer,
+    prompts,
+    sft_samples,
+    summary_overlap_metric,
+)
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+model_path, tokenizer_path = default_model_and_tokenizer()
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=model_path),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
+    train=dict(seq_length=128, batch_size=32, total_steps=300, tracker=None,
+               checkpoint_dir=SFT_DIR),
+    method=dict(gen_kwargs=dict(max_new_tokens=24, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    return trlx.train(
+        samples=sft_samples(n=256, seed=config.train.seed),
+        eval_prompts=prompts(8),
+        metric_fn=summary_overlap_metric,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -41,6 +41,9 @@ EXAMPLES = [
     ("examples.sentiments.ilql_sentiments_t5", TINY),
     ("examples.sentiments.sft_sentiments", TINY),
     ("examples.sentiments.rft_sentiments", TINY_RFT),
+    ("examples.sft_alpaca", {**TINY, "train.seq_length": 160}),
+    ("examples.summarize_daily_cnn_t5", TINY_PPO),
+    ("examples.summarize_rlhf.train_sft", {**TINY, "train.seq_length": 96}),
     ("examples.hh.ppo_hh", TINY_PPO),
     # HH prompts are ~50 byte-tokens; leave room for the output tokens
     ("examples.hh.ilql_hh", {**TINY, "train.seq_length": 96}),

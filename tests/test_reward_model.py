@@ -1,0 +1,114 @@
+"""Reward-model layer (trlx_tpu/models/reward.py — the reference's
+summarize_rlhf GPTRewardModel equivalent): pairwise loss math, head
+indexing under padding, and learnability on a separable synthetic task."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from trlx_tpu.models import config_from_preset  # noqa: E402
+from trlx_tpu.models.reward import (  # noqa: E402
+    CausalLMWithRewardHead,
+    make_reward_fn,
+    pairwise_loss,
+)
+
+
+def _build():
+    cfg = config_from_preset("gpt2-tiny", vocab_size=64, dtype=jnp.float32)
+    model = CausalLMWithRewardHead(cfg)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    return cfg, model, params
+
+
+def test_pairwise_loss_math():
+    rc = jnp.asarray([2.0, 0.0])
+    rr = jnp.asarray([0.0, 2.0])
+    loss, stats = pairwise_loss(rc, rr)
+    expected = -(np.log(1 / (1 + np.exp(-2.0))) + np.log(1 / (1 + np.exp(2.0)))) / 2
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-6)
+    assert float(stats["accuracy"]) == 0.5
+
+
+def test_reward_uses_last_valid_token():
+    """Padding after the last valid token must not change the reward."""
+    _, model, params = _build()
+    tokens = jnp.asarray([[5, 6, 7, 0, 0, 0, 0, 0]], jnp.int32)
+    mask3 = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], jnp.int32)
+    r1 = model.apply({"params": params}, tokens, mask3)
+    garbage = tokens.at[0, 5].set(33)
+    r2 = model.apply({"params": params}, garbage, mask3)
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=1e-6)
+
+
+def test_rm_learns_separable_preferences():
+    """A few steps of pairwise training must separate an easy preference
+    (chosen sequences start with token 1, rejected with token 2)."""
+    _, model, params = _build()
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    rng = np.random.default_rng(0)
+
+    @jax.jit
+    def step(params, opt_state, c_tok, c_mask, r_tok, r_mask):
+        def loss_fn(p):
+            return pairwise_loss(
+                model.apply({"params": p}, c_tok, c_mask),
+                model.apply({"params": p}, r_tok, r_mask),
+            )
+
+        (_, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, stats
+
+    def batch(lead):
+        toks = rng.integers(3, 60, size=(16, 8)).astype(np.int32)
+        toks[:, 0] = lead
+        return jnp.asarray(toks), jnp.ones((16, 8), jnp.int32)
+
+    stats = None
+    for _ in range(60):
+        c_tok, c_mask = batch(1)
+        r_tok, r_mask = batch(2)
+        params, opt_state, stats = step(params, opt_state, c_tok, c_mask, r_tok, r_mask)
+    assert float(stats["accuracy"]) > 0.9
+
+
+def test_make_reward_fn_contract():
+    from trlx_tpu.data.configs import TokenizerConfig
+    from trlx_tpu.tokenizers import get_tokenizer
+
+    _, model, params = _build()
+    tokenizer = get_tokenizer(TokenizerConfig(tokenizer_path="char:abcdefgh"))
+    fn = make_reward_fn(model, params, tokenizer, max_length=8, batch_size=2)
+    scores = fn(["abc", "defg", "h"])
+    assert len(scores) == 3 and all(isinstance(s, float) for s in scores)
+
+
+@pytest.mark.slow
+def test_summarize_rlhf_recipe(tmp_path, monkeypatch):
+    """The three-stage pipeline end-to-end with tiny settings: RM training
+    reaches high accuracy on the synthetic pairs, PPO consumes it."""
+    import examples.summarize_rlhf as task
+
+    monkeypatch.setattr(task, "RM_PARAMS_PATH", str(tmp_path / "rm.msgpack"))
+    from examples.summarize_rlhf import ppo_summarize, train_reward_model
+
+    monkeypatch.setattr(train_reward_model, "RM_PARAMS_PATH", str(tmp_path / "rm.msgpack"))
+    monkeypatch.setattr(ppo_summarize, "RM_PARAMS_PATH", str(tmp_path / "rm.msgpack"))
+
+    acc = train_reward_model.main({"steps": 120, "batch_size": 16})
+    assert acc > 0.7, f"reward model failed to learn synthetic preferences: {acc}"
+
+    trainer = ppo_summarize.main({
+        "train.total_steps": 2, "train.batch_size": 4, "train.seq_length": 64,
+        "train.eval_interval": 10, "train.checkpoint_interval": 100,
+        "train.checkpoint_dir": str(tmp_path / "ppo"),
+        "method.num_rollouts": 4, "method.chunk_size": 4, "method.ppo_epochs": 1,
+        "method.gen_kwargs.max_new_tokens": 8,
+    })
+    assert trainer is not None

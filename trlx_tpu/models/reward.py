@@ -1,0 +1,79 @@
+"""Reward model: LM trunk + scalar reward head, with pairwise preference
+training utilities.
+
+Parity: the reference ships reward-model training inside
+examples/summarize_rlhf/reward_model/ (GPTRewardModel: GPT-J trunk +
+nn.Linear v_head scoring the last token, trained with a pairwise ranking
+loss over chosen/rejected pairs). Here the reward model is a first-class
+model-layer citizen reusing the same TransformerLM families and running
+the pairwise loss as a jitted pure function.
+"""
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.heads import MLPHead
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+class CausalLMWithRewardHead(nn.Module):
+    """Scalar per-sequence reward = MLP head over the hidden state of the
+    last valid (non-padded) token."""
+
+    cfg: TransformerConfig
+
+    def setup(self):
+        self.lm = TransformerLM(self.cfg, name="lm")
+        self.r_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="r_head")
+
+    def __call__(self, tokens: jnp.ndarray, attn_mask: jnp.ndarray) -> jnp.ndarray:
+        """Returns rewards [batch]."""
+        _, _, h_final = self.lm(tokens, attn_mask, None, 0)
+        last = jnp.clip(attn_mask.sum(-1) - 1, 0, None)  # [b]
+        h_last = jnp.take_along_axis(
+            h_final, last[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        return self.r_head(h_last)[..., 0]
+
+
+def pairwise_loss(r_chosen: jnp.ndarray, r_rejected: jnp.ndarray) -> Tuple[jnp.ndarray, Dict]:
+    """-log sigmoid(r_chosen - r_rejected), the Bradley-Terry preference
+    loss the reference RM uses (summarize_rlhf/reward_model/reward_model.py)."""
+    margin = r_chosen - r_rejected
+    loss = -jax.nn.log_sigmoid(margin).mean()
+    stats = {
+        "loss": loss,
+        "accuracy": (margin > 0).mean(),
+        "margin": margin.mean(),
+    }
+    return loss, stats
+
+
+def make_reward_fn(model: CausalLMWithRewardHead, params: Dict, tokenizer, max_length: int,
+                   batch_size: int = 32, norm_offset: float = 0.0):
+    """Wrap a trained RM into the trlx reward_fn contract (the reference
+    normalizes PPO rewards by the SFT baseline score the same way,
+    examples/summarize_rlhf/trlx_gptj_text_summarization.py)."""
+    import numpy as np
+
+    @jax.jit
+    def score(params, tokens, mask):
+        return model.apply({"params": params}, tokens, mask)
+
+    def reward_fn(samples, **kwargs):
+        out = []
+        for i in range(0, len(samples), batch_size):
+            enc = tokenizer(
+                list(samples[i:i + batch_size]),
+                max_length=max_length, truncation=True, padding="max_length",
+            )
+            out.extend(
+                np.asarray(score(params, enc["input_ids"], enc["attention_mask"]))
+                - norm_offset
+            )
+        return [float(x) for x in out]
+
+    return reward_fn

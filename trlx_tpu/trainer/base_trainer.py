@@ -114,6 +114,20 @@ class TPUTrainer(BaseRLTrainer):
         self.generate_kwargs = dict(config.method.gen_kwargs or {})
         self.generate_experience_kwargs = getattr(config.method, "gen_experience_kwargs", None)
 
+        # A single list-valued gen kwarg becomes an eval-time sweep
+        # (reference generate_sweep_kwarg, accelerate_base_trainer.py:139-146):
+        # evaluate() runs once per value and logs metrics with @k=v suffixes.
+        self.generate_sweep_kwarg = None
+        for k, v in list(self.generate_kwargs.items()):
+            if isinstance(v, list):
+                if self.generate_sweep_kwarg is not None:
+                    logger.info(f"Only a single sweep is allowed, {k} is going to be set to {v[0]}")
+                    self.generate_kwargs[k] = v[0]
+                else:
+                    self.generate_sweep_kwarg = (k, v)
+                    # rollout generation (non-eval) uses the first value
+                    self.generate_kwargs[k] = v[0]
+
         self._train_step_fn = None
         self._accum_fns = None
         self._generate_cache: Dict[Any, Callable] = {}
@@ -504,73 +518,92 @@ class TPUTrainer(BaseRLTrainer):
 
     def evaluate(self) -> Dict[str, Any]:
         """Generate on eval prompts, score with reward_fn/metric_fn
-        (reference accelerate_base_trainer.py:339-500)."""
+        (reference accelerate_base_trainer.py:339-500). With a list-valued
+        gen kwarg the whole pass repeats per value, metrics suffixed
+        @k=v (the reference's generation sweep)."""
         logger.info("Evaluating model")
         clock = Clock()
-        all_samples, all_prompts, all_outputs = [], [], []
-        all_metadata = []
-        gen_kwargs = self.generate_kwargs
+        stats: Dict[str, Any] = {}
 
-        for batch in self.eval_dataloader:
-            out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
-            samples = np.asarray(out["samples"])
-            prompts = np.asarray(batch["input_ids"])
-            str_samples, str_prompts, str_outputs = self.decode(prompts, samples)
-            all_samples += str_samples
-            all_prompts += str_prompts
-            all_outputs += str_outputs
-            metadata = {
-                k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
-            }
-            all_metadata.append(metadata)
+        if self.generate_sweep_kwarg is not None:
+            sweep_arg, sweep_values = self.generate_sweep_kwarg
+        else:
+            sweep_arg, sweep_values = None, [None]
 
-        stats: Dict[str, Any] = {"time/generate": clock.tick()}
+        for sweep_value in sweep_values:
+            if sweep_value is not None:
+                gen_kwargs = {**self.generate_kwargs, sweep_arg: sweep_value}
+                suffix = f"@{sweep_arg}={sweep_value}"
+            else:
+                gen_kwargs = self.generate_kwargs
+                suffix = ""
 
-        metadata = {}
-        for md in all_metadata:
-            for k, v in md.items():
-                metadata.setdefault(k, []).extend(v)
+            all_samples, all_prompts, all_outputs = [], [], []
+            all_metadata = []
+            clock.tick()  # reset: exclude the previous value's scoring time
+            for batch in self.eval_dataloader:
+                out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
+                samples = np.asarray(out["samples"])
+                prompts = np.asarray(batch["input_ids"])
+                str_samples, str_prompts, str_outputs = self.decode(prompts, samples)
+                all_samples += str_samples
+                all_prompts += str_prompts
+                all_outputs += str_outputs
+                metadata = {
+                    k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
+                }
+                all_metadata.append(metadata)
 
-        if jax.process_index() == 0:
-            rows = list(zip(all_prompts, all_outputs))
-            if self.reward_fn:
-                rewards = self.reward_fn(
-                    samples=all_samples,
-                    prompts=all_prompts,
-                    outputs=all_outputs,
-                    tokenizer=self.tokenizer,
-                    **metadata,
-                )
-                rewards = [
-                    float(np.sum(np.asarray(r))) if np.ndim(r) > 0 else float(r)
-                    for r in rewards
-                ]
-                rows = [r + (reward,) for r, reward in zip(rows, rewards)]
-                stats["reward/mean"] = float(np.mean(rewards))
-            if self.metric_fn:
-                metrics = self.metric_fn(
-                    samples=all_samples,
-                    prompts=all_prompts,
-                    outputs=all_outputs,
-                    **metadata,
-                )
-                for k, v in metrics.items():
-                    if np.ndim(v) > 0 and len(v):
-                        stats[f"metrics/{k}"] = float(np.mean(np.asarray(v, dtype=np.float64)))
-                    else:
-                        stats[f"metrics/{k}"] = float(v)
-            self._print_samples_table(rows)
+            # accumulated over sweep values (one generation pass per value)
+            stats["time/generate"] = stats.get("time/generate", 0.0) + clock.tick()
+
+            metadata = {}
+            for md in all_metadata:
+                for k, v in md.items():
+                    metadata.setdefault(k, []).extend(v)
+
+            if jax.process_index() == 0:
+                rows = list(zip(all_prompts, all_outputs))
+                if self.reward_fn:
+                    rewards = self.reward_fn(
+                        samples=all_samples,
+                        prompts=all_prompts,
+                        outputs=all_outputs,
+                        tokenizer=self.tokenizer,
+                        **metadata,
+                    )
+                    rewards = [
+                        float(np.sum(np.asarray(r))) if np.ndim(r) > 0 else float(r)
+                        for r in rewards
+                    ]
+                    rows = [r + (reward,) for r, reward in zip(rows, rewards)]
+                    stats[f"reward/mean{suffix}"] = float(np.mean(rewards))
+                    # headline metric (save_best) = first sweep value's reward
+                    stats.setdefault("reward/mean", stats[f"reward/mean{suffix}"])
+                if self.metric_fn:
+                    metrics = self.metric_fn(
+                        samples=all_samples,
+                        prompts=all_prompts,
+                        outputs=all_outputs,
+                        **metadata,
+                    )
+                    for k, v in metrics.items():
+                        if np.ndim(v) > 0 and len(v):
+                            stats[f"metrics/{k}{suffix}"] = float(np.mean(np.asarray(v, dtype=np.float64)))
+                        else:
+                            stats[f"metrics/{k}{suffix}"] = float(v)
+                self._print_samples_table(rows, title_suffix=suffix)
 
         self.nth_evaluation += 1
         return stats
 
-    def _print_samples_table(self, rows, max_rows: int = 8):
+    def _print_samples_table(self, rows, max_rows: int = 8, title_suffix: str = ""):
         try:
             from rich.console import Console
             from rich.table import Table
 
             columns = ["prompt", "output"] + (["reward"] if rows and len(rows[0]) > 2 else [])
-            table = Table(*columns, title=f"Evaluation #{self.nth_evaluation}", show_lines=True)
+            table = Table(*columns, title=f"Evaluation #{self.nth_evaluation}{title_suffix}", show_lines=True)
             for row in rows[:max_rows]:
                 table.add_row(*[str(significant(x)) if isinstance(x, float) else str(x) for x in row])
             Console().print(table)

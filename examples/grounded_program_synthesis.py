@@ -1,0 +1,95 @@
+"""Grounded program synthesis PPO (parity with reference
+examples/grounded_program_synthesis/: generate list-DSL programs judged by
+executing them against the target output — reward is grounded in an
+interpreter, not a learned model)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) + "/..")
+
+import numpy as np
+
+import trlx_tpu as trlx
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+# Toy DSL: programs are sequences of ops applied to a digit list.
+OPS = {
+    "rev": lambda xs: xs[::-1],
+    "sort": lambda xs: sorted(xs),
+    "inc": lambda xs: [(x + 1) % 10 for x in xs],
+    "dup": lambda xs: xs + xs,
+}
+
+
+def run_program(tokens, xs):
+    for t in tokens:
+        if t not in OPS:
+            return None  # invalid program
+        xs = OPS[t](xs)
+        if len(xs) > 16:
+            return None
+    return xs
+
+
+def make_task(rng):
+    xs = [int(d) for d in rng.integers(0, 10, size=4)]
+    prog = [list(OPS)[rng.integers(len(OPS))] for _ in range(int(rng.integers(1, 3)))]
+    target = run_program(prog, xs)
+    prompt = f"input: {''.join(map(str, xs))} output: {''.join(map(str, target))} program:"
+    return prompt
+
+
+def interpreter_reward(samples, prompts, outputs, **kwargs):
+    """Execute the generated program; reward = 1 for exact output match,
+    partial credit for valid programs, -1 for invalid ones (the
+    reference's grounded judge, examples/grounded_program_synthesis)."""
+    scores = []
+    for prompt, output in zip(prompts, outputs):
+        try:
+            left = prompt.split("input: ")[1]
+            xs = [int(c) for c in left.split(" output: ")[0]]
+            target = [int(c) for c in left.split(" output: ")[1].split(" program:")[0]]
+        except (IndexError, ValueError):
+            scores.append(-1.0)
+            continue
+        result = run_program(output.split(), xs)
+        if result is None:
+            scores.append(-1.0)
+        elif result == target:
+            scores.append(1.0)
+        else:
+            match = sum(a == b for a, b in zip(result, target)) / max(len(target), 1)
+            scores.append(float(match) * 0.5)
+    return scores
+
+
+local = os.environ.get("TRLX_TPU_MODEL_DIR")
+default_config = default_ppo_config().evolve(
+    model=dict(model_path=local if local and os.path.isdir(local) else "random:gpt2-tiny"),
+    tokenizer=dict(tokenizer_path=local if local and os.path.isdir(local) else "byte"),
+    train=dict(seq_length=96, batch_size=16, total_steps=300, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/grounded_program_synthesis"),
+    method=dict(num_rollouts=64, chunk_size=16,
+                gen_kwargs=dict(max_new_tokens=16, top_k=0, top_p=1.0, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    rng = np.random.default_rng(config.train.seed)
+    prompts = [make_task(rng) for _ in range(128)]
+    return trlx.train(
+        reward_fn=interpreter_reward,
+        prompts=prompts[:112],
+        eval_prompts=prompts[112:120],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -1,0 +1,59 @@
+"""Simulacra-style ILQL (parity with reference examples/simulacra.py:
+offline RL from (image prompt, generation, human rating) triples pulled
+from the Simulacra Aesthetic Captions database — here a synthetic rated
+prompt set, same offline ILQL path)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) + "/..")
+
+import numpy as np
+
+import trlx_tpu as trlx
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ilql_config
+
+SUBJECTS = ["a castle", "a forest", "a city", "an ocean", "a mountain"]
+STYLES_GOOD = ["in golden light", "highly detailed", "masterful composition"]
+STYLES_BAD = ["blurry", "low quality", "poorly drawn"]
+
+
+def rated_captions(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    samples, ratings = [], []
+    for _ in range(n):
+        subject = SUBJECTS[rng.integers(len(SUBJECTS))]
+        good = rng.random() < 0.5
+        style = (STYLES_GOOD if good else STYLES_BAD)[rng.integers(3)]
+        samples.append([subject + ",", " " + style])
+        ratings.append(float(rng.normal(8 if good else 3, 1)))
+    return samples, ratings
+
+
+local = os.environ.get("TRLX_TPU_MODEL_DIR")
+default_config = default_ilql_config().evolve(
+    model=dict(model_path=local if local and os.path.isdir(local) else "random:gpt2-tiny"),
+    tokenizer=dict(tokenizer_path=local if local and os.path.isdir(local) else "byte"),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/simulacra"),
+    method=dict(gen_kwargs=dict(max_new_tokens=24, top_k=20, beta=1.0, temperature=1.0)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    samples, ratings = rated_captions(seed=config.train.seed)
+    return trlx.train(
+        samples=samples,
+        rewards=ratings,
+        eval_prompts=[s + "," for s in SUBJECTS],
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

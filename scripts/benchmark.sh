@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Benchmark suite runner (parity with reference scripts/benchmark.sh):
+# runs the fixed example suite with JSONL tracking into logs/bench-<hash>,
+# where <hash> is a content hash of the package source — so runs can be
+# compared across code versions with `python -m trlx_tpu.reference`.
+#
+#   ./scripts/benchmark.sh                 # run the suite
+#   ./scripts/benchmark.sh --only_hash     # print source + git hashes
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+HASH=$(python -m trlx_tpu.reference --hash-only)
+GIT_HASH=$(git rev-parse --short HEAD 2>/dev/null || echo "nogit")
+
+if [[ "${1:-}" == "--only_hash" ]]; then
+    echo "$HASH"
+    echo "$GIT_HASH"
+    exit 0
+fi
+
+OUT="logs/bench-$HASH"
+mkdir -p "$OUT"
+echo "Benchmark run -> $OUT (git $GIT_HASH)"
+
+COMMON='"train.tracker": "jsonl", "train.logging_dir": "'$OUT'"'
+
+# The tiny CI-able benchmark (reference runs randomwalks first, :48-50)
+python examples/randomwalks/ppo_randomwalks.py "{$COMMON, \"train.total_steps\": 60}"
+python examples/randomwalks/ilql_randomwalks.py "{$COMMON, \"train.total_steps\": 60}"
+python examples/sentiments/ppo_sentiments.py "{$COMMON, \"train.total_steps\": 40}"
+python examples/sentiments/ilql_sentiments.py "{$COMMON, \"train.total_steps\": 40}"
+
+# Headline throughput metric
+python bench.py | tee "$OUT/bench.json"
+
+echo "Done. Compare against a previous run with:"
+echo "  python -m trlx_tpu.reference $OUT --against logs/bench-<other-hash>"

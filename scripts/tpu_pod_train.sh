@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (the reference's scripts/slurm_train.sh role,
+# adapted to TPU pods): run the same training script on every TPU VM worker.
+# JAX discovers the pod topology itself (jax.distributed auto-initializes
+# from TPU metadata), so no MASTER_ADDR/NCCL plumbing is needed — each
+# worker simply runs the identical command and the mesh spans all chips.
+#
+# Usage (from a machine with gcloud access to the pod):
+#   ./scripts/tpu_pod_train.sh <tpu-name> <zone> examples/sentiments/ppo_sentiments.py '{"train.batch_size": 256}'
+#
+# For a multi-slice (DCN-connected) deployment, set parallel.data to span
+# slices and fsdp/tensor within a slice in the config's parallel section —
+# collectives ride ICI within slices and DCN across them.
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?zone}
+SCRIPT=${3:?training script}
+HPARAMS=${4:-"{}"}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command="cd ~/trlx_tpu && python $SCRIPT '$HPARAMS'"

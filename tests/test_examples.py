@@ -41,6 +41,9 @@ EXAMPLES = [
     ("examples.sentiments.ilql_sentiments_t5", TINY),
     ("examples.sentiments.sft_sentiments", TINY),
     ("examples.sentiments.rft_sentiments", TINY_RFT),
+    ("examples.architext", TINY_PPO),
+    ("examples.simulacra", TINY),
+    ("examples.grounded_program_synthesis", TINY_PPO),
     ("examples.sft_alpaca", {**TINY, "train.seq_length": 160}),
     ("examples.summarize_daily_cnn_t5", TINY_PPO),
     ("examples.summarize_rlhf.train_sft", {**TINY, "train.seq_length": 96}),

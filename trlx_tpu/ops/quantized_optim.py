@@ -1,0 +1,135 @@
+"""8-bit optimizer states (the reference's bitsandbytes role,
+SURVEY.md §2.6: `bnb.optim.Adam8bit` via trlx/utils/__init__.py:104-123 and
+accelerate_base_trainer.py:183-191).
+
+Adam's m/v moments are stored block-wise quantized to int8 with one f32
+absmax scale per block: ~4x less optimizer-state HBM per moment.
+Quantize/dequantize run in-graph around the standard Adam math, so the
+whole update stays one fused XLA program — no custom kernels needed on
+TPU, the VPU handles the int8<->f32 casts inline.
+
+Where bitsandbytes uses a nonlinear dynamic code to cover the second
+moment's huge dynamic range, v is quantized in SQRT space here: the
+ratio between a block's largest and smallest sqrt(v) equals the gradient
+ratio (not its square), so elements whose gradients are 100x below the
+block max still get nonzero codes — a linear code on raw v would round
+them to v=0 and the next update would explode to m_hat/eps. Tensors
+smaller than one block (biases, layernorm scales) are stored exact in
+f32 — the padding overhead would exceed the savings.
+"""
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BLOCK = 256
+
+
+def block_quantize(x: jnp.ndarray, block: int = BLOCK) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x (any shape, f32) -> (int8 codes [n_blocks, block], f32 scales
+    [n_blocks]). Padded flat layout; shape restored by block_dequantize.
+    Tensors smaller than one block are passed through exact (f32 codes,
+    empty scale) — see module docstring."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    if n < block:
+        # size-1 placeholder scale (orbax cannot checkpoint 0-size arrays)
+        return flat, jnp.zeros((1,), jnp.float32)
+    n_blocks = -(-n // block)
+    padded = jnp.zeros((n_blocks * block,), flat.dtype).at[:n].set(flat)
+    blocks = padded.reshape(n_blocks, block)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe[:, None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def block_dequantize(q: jnp.ndarray, scale: jnp.ndarray, shape: Tuple[int, ...]) -> jnp.ndarray:
+    if q.dtype != jnp.int8:  # exact small-tensor passthrough
+        return q.reshape(shape)
+    flat = (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+    n = int(np.prod(shape)) if shape else 1
+    return flat[:n].reshape(shape)
+
+
+class QuantizedMoment(NamedTuple):
+    q: jnp.ndarray  # int8 [n_blocks, BLOCK]
+    scale: jnp.ndarray  # f32 [n_blocks]
+
+
+class Adam8bitState(NamedTuple):
+    count: jnp.ndarray
+    m: Any  # pytree of QuantizedMoment
+    v: Any  # pytree of QuantizedMoment
+
+
+def scale_by_adam_8bit(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    """optax transformation: Adam scaling with int8 block-quantized
+    moments. m is linear-coded; v is coded in sqrt space (see module
+    docstring for why a linear code on raw v diverges)."""
+
+    def quant_tree(tree):
+        return jax.tree_util.tree_map(
+            lambda g: QuantizedMoment(*block_quantize(jnp.zeros_like(g, jnp.float32))), tree
+        )
+
+    def init_fn(params):
+        return Adam8bitState(
+            count=jnp.zeros([], jnp.int32), m=quant_tree(params), v=quant_tree(params)
+        )
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+
+        def one(g, qm, qv):
+            out_dtype = g.dtype
+            m = block_dequantize(qm.q, qm.scale, g.shape)
+            v = jnp.square(block_dequantize(qv.q, qv.scale, g.shape))
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            m_hat = m / (1 - b1 ** count.astype(jnp.float32))
+            v_hat = v / (1 - b2 ** count.astype(jnp.float32))
+            upd = (m_hat / (jnp.sqrt(v_hat) + eps)).astype(out_dtype)
+            return upd, QuantizedMoment(*block_quantize(m)), QuantizedMoment(*block_quantize(jnp.sqrt(v)))
+
+        flat_u, tree_def = jax.tree_util.tree_flatten(updates)
+        flat_m = tree_def.flatten_up_to(state.m)
+        flat_v = tree_def.flatten_up_to(state.v)
+        out = [one(g, qm, qv) for g, qm, qv in zip(flat_u, flat_m, flat_v)]
+        new_updates = tree_def.unflatten([o[0] for o in out])
+        new_m = tree_def.unflatten([o[1] for o in out])
+        new_v = tree_def.unflatten([o[2] for o in out])
+        return new_updates, Adam8bitState(count=count, m=new_m, v=new_v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam_8bit(learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8):
+    return optax.chain(
+        scale_by_adam_8bit(b1, b2, eps),
+        optax.scale_by_learning_rate(learning_rate),
+    )
+
+
+def adamw_8bit(
+    learning_rate, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+    weight_decay: float = 1e-4, mask: Optional[Any] = None,
+):
+    return optax.chain(
+        scale_by_adam_8bit(b1, b2, eps),
+        optax.add_decayed_weights(weight_decay, mask),
+        optax.scale_by_learning_rate(learning_rate),
+    )
+
+
+def opt_state_bytes(state) -> int:
+    """Total bytes of an optimizer-state pytree (for memory assertions)."""
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree_util.tree_leaves(state)
+        if hasattr(x, "dtype")
+    )

@@ -78,7 +78,8 @@ def infinite_dataloader(dataloader: Iterable) -> Iterable:
 
 class OptimizerName(str, Enum):
     """Supported optimizer names (reference: trlx/utils/__init__.py:83-101;
-    the bitsandbytes 8-bit variants map to plain optax counterparts here)."""
+    the bitsandbytes 8-bit variants map to block-wise int8-quantized
+    moment states, trlx_tpu/ops/quantized_optim.py)."""
 
     ADAM = "adam"
     ADAMW = "adamw"
@@ -105,12 +106,24 @@ def get_optimizer(
     momentum = kwargs.pop("momentum", 0.9)
 
     name = OptimizerName(name.lower())
-    if name in (OptimizerName.ADAMW, OptimizerName.ADAMW_8BIT_BNB):
+    if name == OptimizerName.ADAMW:
         return optax.adamw(
             learning_rate, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay, **kwargs
         )
-    if name in (OptimizerName.ADAM, OptimizerName.ADAM_8BIT_BNB):
+    if name == OptimizerName.ADAM:
         return optax.adam(learning_rate, b1=betas[0], b2=betas[1], eps=eps, **kwargs)
+    if name == OptimizerName.ADAMW_8BIT_BNB:
+        from trlx_tpu.ops.quantized_optim import adamw_8bit
+
+        # forward **kwargs so unknown/typo'd keys raise like other branches
+        return adamw_8bit(
+            learning_rate, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay, **kwargs
+        )
+    if name == OptimizerName.ADAM_8BIT_BNB:
+        from trlx_tpu.ops.quantized_optim import adam_8bit
+
+        return adam_8bit(learning_rate, b1=betas[0], b2=betas[1], eps=eps, **kwargs)
     if name == OptimizerName.SGD:
         return optax.sgd(learning_rate, momentum=momentum, **kwargs)
     if name == OptimizerName.LION:

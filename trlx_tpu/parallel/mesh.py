@@ -88,11 +88,31 @@ class MeshRuntime:
     @classmethod
     def from_config(cls, parallel_config, devices=None) -> "MeshRuntime":
         if getattr(parallel_config, "pipeline", 1) not in (1, None):
-            raise NotImplementedError(
-                "parallel.pipeline > 1 is not wired into the GSPMD trainer "
-                "family yet; use trlx_tpu.parallel.pipeline.make_gpipe_forward "
-                "for pipelined forwards, or data/fsdp/tensor/sequence axes here"
+            # ("data", "pipe") mesh for GPipe trainers; fsdp/tensor compose
+            # with PP only through the stacked-param layout those trainers
+            # own, so they must stay 1 here.
+            if parallel_config.fsdp != 1 or parallel_config.tensor != 1 or parallel_config.sequence != 1:
+                raise NotImplementedError(
+                    "parallel.pipeline composes with the data axis only "
+                    "(DP x PP); set fsdp/tensor/sequence to 1"
+                )
+            from trlx_tpu.parallel.pipeline import make_pipe_mesh
+
+            devices = devices if devices is not None else jax.devices()
+            pipe = parallel_config.pipeline
+            data = parallel_config.data
+            if data == -1:
+                data = len(devices) // pipe
+            if data * pipe > len(devices):
+                raise ValueError(
+                    f"data={data} x pipeline={pipe} needs {data * pipe} devices, "
+                    f"{len(devices)} available"
+                )
+            mesh = make_pipe_mesh(pipe, devices=devices[: data * pipe])
+            logger.info(
+                f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
             )
+            return PipeMeshRuntime(mesh=mesh)
         mesh = make_mesh(
             data=parallel_config.data,
             fsdp=parallel_config.fsdp,
@@ -154,6 +174,47 @@ class MeshRuntime:
             if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 1:
                 arr = np.asarray(x)
                 target = sharding if arr.shape[0] % dp == 0 else replicated
+                return jax.device_put(arr, target)
+            return x
+
+        return jax.tree_util.tree_map(_place, batch)
+
+
+@dataclass
+class PipeMeshRuntime(MeshRuntime):
+    """Mesh runtime over ("data", "pipe") axes for GPipe trainers
+    (trlx_tpu/trainer/pipelined_sft_trainer.py). Batches shard over
+    "data"; block params live stacked and sharded over "pipe"."""
+
+    @property
+    def dp_size(self) -> int:
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return shape["data"]
+
+    @property
+    def n_stages(self) -> int:
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return shape["pipe"]
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        return self.sharding("data")
+
+    @property
+    def pipe_sharding(self) -> NamedSharding:
+        return self.sharding("pipe")
+
+    def shard_batch_stacked(self, batch):
+        """Stacked [n_steps, batch, ...] placement on the pipe mesh: step
+        dim replicated, batch dim sharded over "data" only."""
+        sharding = self.sharding(None, "data")
+        replicated = self.replicated
+        dp = self.dp_size
+
+        def _place(x):
+            if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 2:
+                arr = np.asarray(x)
+                target = sharding if arr.shape[1] % dp == 0 else replicated
                 return jax.device_put(arr, target)
             return x
 

@@ -76,6 +76,16 @@ def make_pipe_mesh(n_stages: int, devices=None) -> Mesh:
     return Mesh(arr, ("data", PIPE_AXIS))
 
 
+def unstack_block_params(stacked: Dict, rest: Dict, n_layers: int) -> Dict:
+    """Inverse of stack_block_params: rebuild the standard per-block param
+    layout (block_0..block_{n-1} + non-block entries)."""
+    flat = jax.tree_util.tree_map(lambda x: x.reshape(n_layers, *x.shape[2:]), stacked)
+    out = dict(rest)
+    for i in range(n_layers):
+        out[f"block_{i}"] = jax.tree_util.tree_map(lambda x: x[i], flat)
+    return out
+
+
 def stack_block_params(params: Dict, n_layers: int, n_stages: int) -> Tuple[Dict, Dict]:
     """Split a TransformerLM param tree into (stacked block params with
     leading [n_stages, layers_per_stage], non-block params). The inverse of
@@ -174,6 +184,45 @@ def gpipe_blocks(
     return out.reshape(B, t, d)
 
 
+def make_gpipe_forward_stacked(
+    model,  # TransformerLM (or a module exposing embed/unembed + blocks)
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    with_hidden: bool = False,
+) -> Callable:
+    """Build fn(stacked, rest, tokens, attn_mask) -> logits (or
+    (logits, h_final) with with_hidden) where `stacked` is the
+    [n_stages, lps, ...] block pytree living sharded over the "pipe" axis
+    — the layout the pipelined trainer keeps params in permanently, so no
+    per-call restacking."""
+
+    def embed(rest_params, tokens, attn_mask):
+        positions = position_ids(attn_mask)
+        return model.apply({"params": {**rest_params}}, tokens, positions, method=model.embed)
+
+    def unembed(rest_params, h):
+        return model.apply({"params": {**rest_params}}, h, method=model.unembed)
+
+    def inner(stacked, rest, tokens, attn_mask):
+        h = embed(rest, tokens, attn_mask)
+        h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
+        logits, h_final = unembed(rest, h)
+        return (logits, h_final) if with_hidden else logits
+
+    # Batch sharded over the mesh's "data" axis (DP x PP hybrid: each
+    # data slice runs its own pipeline over the shared stage params);
+    # shard_map's transpose inserts the data-axis grad psum for the
+    # replicated params automatically.
+    out_spec = (P("data"), P("data")) if with_hidden else P("data")
+    return shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(), P("data"), P("data")),
+        out_specs=out_spec,
+    )
+
+
 def make_gpipe_forward(
     model,  # TransformerLM (or a module exposing embed/unembed + blocks)
     cfg: TransformerConfig,
@@ -187,33 +236,10 @@ def make_gpipe_forward(
     the jitted fn so the same checkpoint format serves every layout (the
     reference instead reshards checkpoints per PP stage,
     modeling_nemo_ppo.py:321-352)."""
-
-    def embed_unembed(rest_params, tokens, attn_mask, h_mid):
-        """Non-block compute, replicated on every stage."""
-        wrapped = {"params": {**rest_params}}
-        if h_mid is None:  # embedding
-            positions = position_ids(attn_mask)
-            return model.apply(wrapped, tokens, positions, method=model.embed)
-        logits, _ = model.apply(wrapped, h_mid, method=model.unembed)
-        return logits
+    stacked_fwd = make_gpipe_forward_stacked(model, cfg, mesh, n_microbatches)
 
     def fwd(params, tokens, attn_mask):
         stacked, rest = stack_block_params(params, cfg.n_layers, n_stages)
-
-        def inner(stacked, rest, tokens, attn_mask):
-            h = embed_unembed(rest, tokens, attn_mask, None)
-            h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
-            return embed_unembed(rest, tokens, attn_mask, h)
-
-        # Batch sharded over the mesh's "data" axis (DP x PP hybrid: each
-        # data slice runs its own pipeline over the shared stage params);
-        # shard_map's transpose inserts the data-axis grad psum for the
-        # replicated params automatically.
-        return shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(P(PIPE_AXIS), P(), P("data"), P("data")),
-            out_specs=P("data"),
-        )(stacked, rest, tokens, attn_mask)
+        return stacked_fwd(stacked, rest, tokens, attn_mask)
 
     return fwd

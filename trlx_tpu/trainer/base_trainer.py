@@ -83,8 +83,7 @@ class TPUTrainer(BaseRLTrainer):
         # Model + params (sharded onto the mesh by the rule table)
         self.model, self.model_cfg, params = self.get_arch(config)
         self.split = resolve_split(self.model_cfg, config.model.num_layers_unfrozen)
-        self.param_shardings = infer_param_shardings(self.runtime.mesh, params)
-        params = jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
+        params = self.place_params(params)
 
         # Trainable/frozen partition + optimizer over the trainable tree only
         mask_tree = self.make_trainable_mask(params)
@@ -155,6 +154,21 @@ class TPUTrainer(BaseRLTrainer):
     @abstractmethod
     def create_train_dataloader(self):
         pass
+
+    def place_params(self, params) -> Dict:
+        """Device-place the initialized params (rule-table GSPMD sharding;
+        pipelined trainers override with their stacked layout)."""
+        from trlx_tpu.parallel.mesh import PipeMeshRuntime
+
+        if isinstance(self.runtime, PipeMeshRuntime):
+            raise NotImplementedError(
+                f"parallel.pipeline > 1 requires a pipeline-aware trainer "
+                f"(train.trainer: PipelinedSFTTrainer), not "
+                f"{type(self).__name__}; or use data/fsdp/tensor/sequence "
+                "axes with this trainer"
+            )
+        self.param_shardings = infer_param_shardings(self.runtime.mesh, params)
+        return jax.tree_util.tree_map(jax.device_put, params, self.param_shardings)
 
     def make_trainable_mask(self, params) -> Dict:
         return trainable_mask(params, self.model_cfg, self.config.model.num_layers_unfrozen)

@@ -28,6 +28,24 @@ class SFTConfig(MethodConfig):
     gen_kwargs: dict = field(default_factory=dict)
 
 
+def causal_lm_ce_loss(logits, input_ids, attention_mask, labels=None):
+    """Shifted CE over real tokens (reference
+    accelerate_sft_trainer.py:63-70 masks labels by attention). Shared by
+    the plain and pipelined SFT trainers so their losses cannot drift."""
+    ignore_index = DialogStore.IGNORE_INDEX
+    if labels is None:
+        labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
+    shift_logits = logits[:, :-1, :].astype(jnp.float32)
+    shift_labels = labels[:, 1:]
+    valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
+    logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
+    safe_labels = jnp.where(valid, shift_labels, 0)
+    nll = -jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(valid.sum(), 1)
+    loss = jnp.where(valid, nll, 0.0).sum() / n
+    return loss, {"loss": loss}
+
+
 @register_trainer
 class SFTTrainer(TPUTrainer):
     def get_arch(self, config: TRLConfig):
@@ -46,29 +64,15 @@ class SFTTrainer(TPUTrainer):
 
     def make_loss_fn(self) -> Callable:
         model = self.model
-        ignore_index = DialogStore.IGNORE_INDEX
 
         def loss_fn(train_params, frozen_params, batch):
             params = merge_params(train_params, frozen_params)
             input_ids = batch["input_ids"]
             attention_mask = batch["attention_mask"]
-            labels = batch.get("labels", None)
-            if labels is None:
-                # loss over all real tokens (reference
-                # accelerate_sft_trainer.py:63-70 masks labels by attention)
-                labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
             logits, _, _ = model.apply(
                 {"params": params}, input_ids, attention_mask, position_ids(attention_mask)
             )
-            shift_logits = logits[:, :-1, :].astype(jnp.float32)
-            shift_labels = labels[:, 1:]
-            valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
-            logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
-            safe_labels = jnp.where(valid, shift_labels, 0)
-            nll = -jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
-            n = jnp.maximum(valid.sum(), 1)
-            loss = jnp.where(valid, nll, 0.0).sum() / n
-            return loss, {"loss": loss}
+            return causal_lm_ce_loss(logits, input_ids, attention_mask, batch.get("labels"))
 
         return loss_fn
 

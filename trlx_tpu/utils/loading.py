@@ -20,6 +20,7 @@ for _mod in (
     "trlx_tpu.trainer.sft_trainer",
     "trlx_tpu.trainer.ilql_trainer",
     "trlx_tpu.trainer.rft_trainer",
+    "trlx_tpu.trainer.pipelined_sft_trainer",
 ):
     try:
         __import__(_mod)

@@ -115,13 +115,18 @@ def test_pipelined_sft_trainer(tmp_path):
 
     def make_config(trainer, pipeline, tmp_sub):
         return default_sft_config().evolve(
-            model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1),
+            # f32 so the loss-parity check is exact (bf16 accumulation
+            # order differs between microbatch sizes)
+            model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                       model_extra_configs=dict(dtype="float32")),
             tokenizer=dict(tokenizer_path="byte"),
             train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
                        eval_interval=10, checkpoint_interval=100, trainer=trainer,
                        checkpoint_dir=str(tmp_path / tmp_sub), seed=11),
             method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
-            parallel=dict(data=2, fsdp=1, tensor=1, pipeline=pipeline),
+            # data x pipeline must cover the full 8-device CPU mesh
+            parallel=dict(data=8 // pipeline if pipeline > 1 else 2,
+                          fsdp=1, tensor=1, pipeline=pipeline),
         )
 
     samples = ["hello world this is text", "another training sample here"] * 8

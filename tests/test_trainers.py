@@ -270,3 +270,25 @@ def test_fused_learn_loop_end_to_end(tmp_path):
     assert trainer.iter_count >= 4
     ckpts = os.listdir(str(tmp_path / "ckpts"))
     assert any(c.startswith("checkpoint_") for c in ckpts), ckpts
+
+
+def test_nan_guard_aborts_on_divergence(tmp_path):
+    """Failure detection: consecutive non-finite losses abort with a
+    clear FloatingPointError instead of training on garbage."""
+    config = ppo_config(tmp_path, total_steps=10)
+    config.train.nan_guard_patience = 2
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    t.total_steps = 10
+    t.iter_count = 1
+    # one bad step: warns, doesn't raise
+    t._check_divergence({"losses/total_loss": float("nan")})
+    assert t._nan_streak == 1
+    # recovery resets the streak
+    t._check_divergence({"losses/total_loss": 1.0})
+    assert t._nan_streak == 0
+    # patience exceeded: abort
+    t._check_divergence({"losses/total_loss": float("inf")})
+    with pytest.raises(FloatingPointError, match="diverged"):
+        t._check_divergence({"losses/total_loss": float("nan")})

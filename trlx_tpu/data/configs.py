@@ -190,6 +190,14 @@ class TrainConfig:
     profile_start: int = 2
     profile_stop: int = 4
 
+    # Divergence guard (goes beyond the reference, which has no failure
+    # detection at all — SURVEY.md §5.3): warn on each step with a
+    # non-finite loss and, after `nan_guard_patience` consecutive bad
+    # steps, abort with a clear error BEFORE any checkpoint write so the
+    # last good checkpoint survives.
+    nan_guard: bool = True
+    nan_guard_patience: int = 3
+
     # Fuse each inner epoch's optimizer steps into ONE jitted lax.scan
     # dispatch (TPU-idiomatic; a torch trainer can't do this). Semantics
     # are identical — one optimizer update per minibatch — but stats are

@@ -103,12 +103,15 @@ class MeshRuntime:
             data = parallel_config.data
             if data == -1:
                 data = len(devices) // pipe
-            if data * pipe > len(devices):
+            if data * pipe != len(devices):
+                # loud, like _resolve_axis_sizes — silently idling devices
+                # is worse than making the user restrict `devices`
                 raise ValueError(
-                    f"data={data} x pipeline={pipe} needs {data * pipe} devices, "
-                    f"{len(devices)} available"
+                    f"data={data} x pipeline={pipe} covers {data * pipe} "
+                    f"devices but {len(devices)} are available; adjust "
+                    "parallel.data/pipeline or pass a device subset"
                 )
-            mesh = make_pipe_mesh(pipe, devices=devices[: data * pipe])
+            mesh = make_pipe_mesh(pipe, devices=devices)
             logger.info(
                 f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
             )
@@ -145,10 +148,15 @@ class MeshRuntime:
     def replicated(self) -> NamedSharding:
         return self.sharding()
 
+    @property
+    def stacked_batch_sharding(self) -> NamedSharding:
+        """Sharding for [n_steps, batch, ...] stacks: step dim replicated
+        (it feeds lax.scan), batch dim over the DP axes."""
+        return self.sharding(None, ("data", "fsdp"))
+
     def shard_batch_stacked(self, batch):
-        """Place a [n_steps, batch, ...] stacked batch pytree: step dim
-        replicated (it feeds lax.scan), batch dim sharded over DP axes."""
-        sharding = self.sharding(None, ("data", "fsdp"))
+        """Place a [n_steps, batch, ...] stacked batch pytree."""
+        sharding = self.stacked_batch_sharding
         replicated = self.replicated
         dp = self.dp_size
 
@@ -204,18 +212,6 @@ class PipeMeshRuntime(MeshRuntime):
     def pipe_sharding(self) -> NamedSharding:
         return self.sharding("pipe")
 
-    def shard_batch_stacked(self, batch):
-        """Stacked [n_steps, batch, ...] placement on the pipe mesh: step
-        dim replicated, batch dim sharded over "data" only."""
-        sharding = self.sharding(None, "data")
-        replicated = self.replicated
-        dp = self.dp_size
-
-        def _place(x):
-            if hasattr(x, "shape") and getattr(x, "ndim", 0) >= 2:
-                arr = np.asarray(x)
-                target = sharding if arr.shape[1] % dp == 0 else replicated
-                return jax.device_put(arr, target)
-            return x
-
-        return jax.tree_util.tree_map(_place, batch)
+    @property
+    def stacked_batch_sharding(self) -> NamedSharding:
+        return self.sharding(None, "data")

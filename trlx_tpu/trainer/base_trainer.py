@@ -464,16 +464,19 @@ class TPUTrainer(BaseRLTrainer):
         def crossed(interval: int) -> bool:
             return self.iter_count // interval > (self.iter_count - n_steps) // interval
 
+        # one batched device->host fetch for the whole stats dict (per-stat
+        # np.asarray would pay one relay round trip each); divergence is
+        # checked BEFORE any checkpoint write so a NaN-poisoned state never
+        # overwrites the last good checkpoint
+        stats = jax.device_get(_flatten_stats(stats))
+        stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
+        self._check_divergence(stats)
+
         if crossed(self.config.train.checkpoint_interval) or done:
             subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
             directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
             self.save(directory)
             self.save_pretrained(os.path.join(directory, "hf_model"))
-
-        # one batched device->host fetch for the whole stats dict (per-stat
-        # np.asarray would pay one relay round trip each)
-        stats = jax.device_get(_flatten_stats(stats))
-        stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
         stats["time/step"] = clock.tick(self.config.train.batch_size * n_steps) / n_steps
         stats["learning_rate"] = float(np.asarray(self.lr_schedule(self.iter_count)))
 
@@ -513,6 +516,32 @@ class TPUTrainer(BaseRLTrainer):
         )
         logger.info(f"[step {self.iter_count}/{self.total_steps}] {loss_desc}")
         return results, best_reward, done
+
+    def _check_divergence(self, stats: Dict[str, Any]):
+        """Failure detection (the reference has none, SURVEY.md §5.3):
+        count consecutive steps with non-finite losses; abort with the
+        last-good-checkpoint pointer once patience runs out."""
+        if not self.config.train.nan_guard:
+            return
+        bad = any(
+            np.ndim(v) == 0 and "loss" in k and not np.isfinite(v)
+            for k, v in stats.items()
+        )
+        if not bad:
+            self._nan_streak = 0
+            return
+        self._nan_streak = getattr(self, "_nan_streak", 0) + 1
+        logger.warning(
+            f"Non-finite loss at step {self.iter_count} "
+            f"({self._nan_streak}/{self.config.train.nan_guard_patience})"
+        )
+        if self._nan_streak >= self.config.train.nan_guard_patience:
+            raise FloatingPointError(
+                f"Loss diverged (non-finite for {self._nan_streak} consecutive "
+                f"steps). Resume from the last checkpoint under "
+                f"'{self.config.train.checkpoint_dir}' with a lower learning "
+                "rate or tighter clipping (train.resume_from_checkpoint)."
+            )
 
     def _maybe_profile_step(self):
         """Capture a jax.profiler trace over the configured step window

@@ -149,6 +149,15 @@ class PipelinedSFTTrainer(SFTTrainer):
 
         return loss_fn
 
+    def create_train_dataloader(self):
+        # drop_last: the GPipe shard_map needs every batch divisible by
+        # data x n_microbatches — a ragged tail batch can't be replicated
+        # the way the GSPMD trainers fall back to
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=True,
+            seed=self.config.train.seed + self.iter_count,
+        )
+
     # ------------------------------------------------------------------
     # Generation / export on the unstacked view
     # ------------------------------------------------------------------
@@ -161,6 +170,14 @@ class PipelinedSFTTrainer(SFTTrainer):
             self.standard_params(), jnp.asarray(input_ids),
             jnp.asarray(np.asarray(attention_mask)), self.next_rng(),
         )
+
+    def evaluate(self):
+        try:
+            return super().evaluate()
+        finally:
+            # release the replicated unstacked copy: it must not occupy
+            # HBM during training steps on models that only fit sharded
+            self._std_params_cache = None
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs):
         # export the standard layout (same HF interop path as every trainer)

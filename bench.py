@@ -88,9 +88,16 @@ def run_cycle(trainer, config):
 def main():
     smoke = "--smoke" in sys.argv
     t0 = time.time()
-    trainer, config = build_trainer(smoke)
 
     import jax
+
+    try:  # persistent XLA compile cache: repeat runs skip the ~2min warmup compile
+        jax.config.update("jax_compilation_cache_dir", "/tmp/trlx_tpu_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    trainer, config = build_trainer(smoke)
 
     n_chips = max(jax.device_count(), 1)
 

@@ -42,7 +42,7 @@ def build_trainer(smoke: bool = False):
         model=dict(model_path=model, num_layers_unfrozen=2),
         tokenizer=dict(tokenizer_path="byte"),
         train=dict(seq_length=128, batch_size=32 if not smoke else 8, tracker=None,
-                   fuse_inner_epoch=True),
+                   fuse_inner_epoch=True, fuse_all_inner_epochs=True),
         method=dict(
             num_rollouts=num_rollouts,
             chunk_size=num_rollouts,
@@ -72,14 +72,22 @@ def run_cycle(trainer, config):
     trainer.store.clear_history()
     trainer.make_experience(config.method.num_rollouts)
     stats = None
-    for _ in range(config.method.ppo_epochs):
-        loader = trainer.store.create_loader(config.train.batch_size, shuffle=True)
-        if config.train.fuse_inner_epoch and trainer.num_mb == 1:
-            # fused inner epoch: all optimizer steps in one lax.scan dispatch
-            stats, _ = trainer.train_inner_epoch_fused(loader)
-        else:
-            for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
-                stats = trainer.train_minibatch(minibatch)
+    if config.train.fuse_all_inner_epochs and trainer.num_mb == 1:
+        # every PPO epoch's optimizer steps in ONE lax.scan dispatch
+        loaders = [
+            trainer.create_train_dataloader(seed_offset=i)
+            for i in range(config.method.ppo_epochs)
+        ]
+        stats, _ = trainer.train_inner_epochs_fused(loaders)
+    else:
+        for epoch in range(config.method.ppo_epochs):
+            loader = trainer.create_train_dataloader(seed_offset=epoch)
+            if config.train.fuse_inner_epoch and trainer.num_mb == 1:
+                # fused inner epoch: one lax.scan dispatch per epoch
+                stats, _ = trainer.train_inner_epoch_fused(loader)
+            else:
+                for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+                    stats = trainer.train_minibatch(minibatch)
     # Force a device->host sync: on the axon relay backend block_until_ready
     # does not block, so timing is only correct after a host copy.
     return float(np.asarray(stats["losses"]["total_loss"]))

@@ -292,3 +292,42 @@ def test_nan_guard_aborts_on_divergence(tmp_path):
     t._check_divergence({"losses/total_loss": float("inf")})
     with pytest.raises(FloatingPointError, match="diverged"):
         t._check_divergence({"losses/total_loss": float("nan")})
+
+
+def test_fuse_all_inner_epochs_matches_per_epoch(tmp_path):
+    """fuse_all_inner_epochs (all PPO epochs in one dispatch) produces the
+    same parameters as per-epoch fused dispatch with identical shuffles."""
+    from trlx_tpu.data import PPORLElement
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    def make_trainer(sub):
+        config = ppo_config(tmp_path / sub)
+        trainer = PPOTrainer(config, reward_fn=count_letters_reward)
+        rng = np.random.default_rng(3)
+        for _ in range(16):
+            n = 5
+            trainer.store.push([
+                PPORLElement(
+                    query_tensor=rng.integers(3, 8, size=4).astype(np.int32),
+                    response_tensor=rng.integers(3, 8, size=n).astype(np.int32),
+                    logprobs=rng.normal(size=n).astype(np.float32),
+                    values=rng.normal(size=n).astype(np.float32),
+                    rewards=rng.normal(size=n).astype(np.float32),
+                )
+            ])
+        return trainer
+
+    t_per = make_trainer("per")
+    for i in range(2):
+        t_per.train_inner_epoch_fused(t_per.create_train_dataloader(seed_offset=i))
+
+    t_all = make_trainer("all")
+    loaders = [t_all.create_train_dataloader(seed_offset=i) for i in range(2)]
+    _, n_steps = t_all.train_inner_epochs_fused(loaders)
+    assert n_steps == 4  # 2 epochs x (16 rollouts / batch 8)
+
+    for k in t_per.train_params:
+        np.testing.assert_allclose(
+            np.asarray(t_per.train_params[k]), np.asarray(t_all.train_params[k]),
+            atol=1e-5, err_msg=str(k),
+        )

@@ -206,6 +206,11 @@ class TrainConfig:
     # Ignored when gradient accumulation is on (minibatch_size <
     # batch_size).
     fuse_inner_epoch: bool = False
+    # Even fewer dispatches: ALL inner epochs (e.g. the 4 PPO epochs over
+    # one rollout store) run as a single lax.scan dispatch; per-epoch
+    # reshuffles are precomputed on host and optimizer-update semantics
+    # are unchanged. Implies fuse_inner_epoch.
+    fuse_all_inner_epochs: bool = False
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

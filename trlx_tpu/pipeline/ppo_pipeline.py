@@ -53,13 +53,27 @@ class PPORolloutStorage(BaseRolloutStore):
     def __len__(self) -> int:
         return len(self.history)
 
-    def create_loader(self, batch_size: int, shuffle: bool = False, seed: int = 0) -> DataLoader:
-        max_q = max(len(e.query_tensor) for e in self.history)
-        max_r = max(len(e.response_tensor) for e in self.history)
+    def create_loader(
+        self,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        max_query_len: int = 0,
+        max_response_len: int = 0,
+        max_stat_len: int = 0,
+    ) -> DataLoader:
+        """Loader with padded-batch collation. Passing the max_*_len
+        widths makes batch shapes STATIC across rollout collections (the
+        store-wide maxima below vary cycle to cycle, which would recompile
+        every jitted consumer — SURVEY.md §7's recompilation-control
+        note); widths are raised to the observed maxima if an element
+        exceeds them, so correctness never depends on the hints."""
+        max_q = max(max(len(e.query_tensor) for e in self.history), max_query_len)
+        max_r = max(max(len(e.response_tensor) for e in self.history), max_response_len)
         # seq2seq responses carry a leading decoder_start token, so the
         # per-token stats are one shorter than the response; pad each field
         # to its own store-wide max.
-        max_p = max(len(e.logprobs) for e in self.history)
+        max_p = max(max(len(e.logprobs) for e in self.history), max_stat_len)
         pad_id = self.pad_token_id
         left_queries = self.padding_side == "left"
 

@@ -96,6 +96,16 @@ class TPUTrainer(BaseRLTrainer):
         self.lr_schedule = get_scheduler(config.scheduler.name, base_lr, config.scheduler.kwargs)
         self.optimizer = get_optimizer(config.optimizer.name, self.lr_schedule, config.optimizer.kwargs)
         self.opt_state = self.optimizer.init(self.train_params)
+        # Commit every opt-state leaf: eagerly-created scalars (e.g. the
+        # Adam step count) are otherwise uncommitted, and the first jitted
+        # call's cache key (UnspecifiedValue) then differs from every
+        # later call's (committed) — one silent full retrace of each train
+        # program after its first execution.
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x: x if getattr(x, "committed", True)
+            else jax.device_put(x, self.runtime.replicated),
+            self.opt_state,
+        )
 
         # Batch/microbatch bookkeeping (reference accelerate_base_trainer.py:77-83)
         self.mb_size = config.train.minibatch_size or config.train.batch_size
@@ -152,8 +162,10 @@ class TPUTrainer(BaseRLTrainer):
         self.n_inner_epochs, self.total_steps."""
 
     @abstractmethod
-    def create_train_dataloader(self):
-        pass
+    def create_train_dataloader(self, seed_offset: int = 0):
+        """Fresh (re-shuffled) loader over the training store; the fused
+        epoch paths pass seed_offset to distinguish epochs created up
+        front."""
 
     def place_params(self, params) -> Dict:
         """Device-place the initialized params (rule-table GSPMD sharding;
@@ -275,6 +287,20 @@ class TPUTrainer(BaseRLTrainer):
         loss_fn = self.make_loss_fn()
         optimizer = self.optimizer
 
+        # Pin param/opt-state outputs to their current (input) shardings:
+        # otherwise the compiler may hand donated outputs back with
+        # different layouts, and the NEXT call retraces — one silent extra
+        # multi-second compile per program.
+        train_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.train_params)
+        opt_sh = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
+        self._state_shardings = (train_sh, opt_sh)
+
+        def pin(train_params, opt_state):
+            return (
+                jax.lax.with_sharding_constraint(train_params, train_sh),
+                jax.lax.with_sharding_constraint(opt_state, opt_sh),
+            )
+
         def grad_fn(train_params, frozen_params, batch):
             (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 train_params, frozen_params, batch
@@ -285,6 +311,7 @@ class TPUTrainer(BaseRLTrainer):
             _, stats, grads = grad_fn(train_params, frozen_params, batch)
             updates, opt_state = optimizer.update(grads, opt_state, train_params)
             train_params = optax.apply_updates(train_params, updates)
+            train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state, stats
 
         def accum_step(train_params, frozen_params, acc_grads, batch):
@@ -296,6 +323,7 @@ class TPUTrainer(BaseRLTrainer):
             grads = jax.tree_util.tree_map(lambda g: g / self.num_mb, acc_grads)
             updates, opt_state = optimizer.update(grads, opt_state, train_params)
             train_params = optax.apply_updates(train_params, updates)
+            train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state
 
         def train_scan(train_params, frozen_params, opt_state, stacked_batches):
@@ -315,6 +343,7 @@ class TPUTrainer(BaseRLTrainer):
                 body, (train_params, opt_state), stacked_batches
             )
             mean_stats = jax.tree_util.tree_map(lambda s: s.mean(0), stats)
+            train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state, mean_stats
 
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 2))
@@ -328,6 +357,16 @@ class TPUTrainer(BaseRLTrainer):
         """Place a host batch onto the mesh, batch-dim sharded over DP axes."""
         return self.runtime.shard_batch(batch)
 
+    def _normalize_state_shardings(self):
+        """Re-commit train state to the canonical sharding objects. Jitted
+        outputs can come back with equivalent-but-differently-expressed
+        NamedShardings; since jit caches key on the sharding OBJECTS, the
+        next call would silently retrace (a multi-second compile per
+        train program). device_put to an equivalent sharding is free."""
+        train_sh, opt_sh = self._state_shardings
+        self.train_params = jax.device_put(self.train_params, train_sh)
+        self.opt_state = jax.device_put(self.opt_state, opt_sh)
+
     def train_minibatch(self, minibatch: List[Any]) -> Dict[str, float]:
         """One optimizer step over `num_mb` microbatches."""
         if self._train_step_fn is None:
@@ -337,6 +376,7 @@ class TPUTrainer(BaseRLTrainer):
                 self.train_params, self.frozen_params, self.opt_state,
                 self.batch_to_device(minibatch[0]),
             )
+            self._normalize_state_shardings()
             return stats
         accum, apply = self._accum_fns
         acc = jax.tree_util.tree_map(jnp.zeros_like, self.train_params)
@@ -345,44 +385,64 @@ class TPUTrainer(BaseRLTrainer):
             acc, stats = accum(self.train_params, self.frozen_params, acc, self.batch_to_device(mb))
             stats_list.append(stats)
         self.train_params, self.opt_state = apply(self.train_params, self.opt_state, acc)
+        self._normalize_state_shardings()
         # average stats across microbatches (reference
         # accelerate_base_trainer.py:580-583)
         return jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *stats_list)
 
     def train_inner_epoch_fused(self, train_dataloader) -> Tuple[Dict[str, float], int]:
         """Run one inner epoch's optimizer steps as a single jitted
-        lax.scan dispatch. Returns (epoch-mean stats, n_steps). Batches
-        must be homogeneous in shape; a ragged tail falls back to per-step
-        dispatch."""
-        if self._train_step_fn is None:
-            self._build_steps()
+        lax.scan dispatch. Returns (epoch-mean stats, n_steps)."""
         batches = [b for mb in MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb)
                    for b in mb]
+        return self.train_batches_fused(batches)
+
+    def train_inner_epochs_fused(self, dataloaders) -> Tuple[Dict[str, float], int]:
+        """ALL inner epochs' optimizer steps in one lax.scan dispatch
+        (config.train.fuse_all_inner_epochs): on dispatch-latency-bound
+        runtimes every avoided dispatch is won wall-clock."""
+        batches = [
+            b
+            for dl in dataloaders
+            for mb in MiniBatchIterator(dl, self.mb_size, self.num_mb)
+            for b in mb
+        ]
+        return self.train_batches_fused(batches)
+
+    def train_batches_fused(self, batches) -> Tuple[Dict[str, float], int]:
+        """Scan the train step over a homogeneous-shape batch prefix in one
+        dispatch; a ragged tail falls back to per-step dispatch."""
+        if self._train_step_fn is None:
+            self._build_steps()
         if not batches:
             return {}, 0
-        # homogeneous-shape PREFIX goes through the scan; any ragged
-        # remainder (e.g. a smaller final batch) dispatches per step
-        lead_shapes = _batch_shapes(batches[0])
-        n_lead = 0
+        # Group maximal runs of same-shape batches: each multi-batch run is
+        # one lax.scan dispatch; singletons (e.g. a ragged per-epoch tail
+        # between full-size epochs) dispatch per step. A prefix-only split
+        # would demote every batch after the first ragged one.
+        runs: List[List[Any]] = []
         for b in batches:
-            if _batch_shapes(b) != lead_shapes:
-                break
-            n_lead += 1
-        lead, tail = batches[:n_lead], batches[n_lead:]
+            if runs and _batch_shapes(b) == _batch_shapes(runs[-1][0]):
+                runs[-1].append(b)
+            else:
+                runs.append([b])
 
         all_stats = []  # (stats pytree, weight)
-        stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *lead)
-        stacked = self.runtime.shard_batch_stacked(stacked)
-        self.train_params, self.opt_state, stats = self._train_scan_fn(
-            self.train_params, self.frozen_params, self.opt_state, stacked
-        )
-        all_stats.append((stats, len(lead)))
-        for batch in tail:
-            self.train_params, self.opt_state, stats = self._train_step_fn(
-                self.train_params, self.frozen_params, self.opt_state,
-                self.batch_to_device(batch),
-            )
-            all_stats.append((stats, 1))
+        for run in runs:
+            if len(run) > 1:
+                stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *run)
+                stacked = self.runtime.shard_batch_stacked(stacked)
+                self.train_params, self.opt_state, stats = self._train_scan_fn(
+                    self.train_params, self.frozen_params, self.opt_state, stacked
+                )
+                all_stats.append((stats, len(run)))
+            else:
+                self.train_params, self.opt_state, stats = self._train_step_fn(
+                    self.train_params, self.frozen_params, self.opt_state,
+                    self.batch_to_device(run[0]),
+                )
+                all_stats.append((stats, 1))
+        self._normalize_state_shardings()
         n_steps = len(batches)
         if len(all_stats) == 1:  # no ragged tail: scan stats are the epoch mean
             return all_stats[0][0], n_steps
@@ -424,7 +484,28 @@ class TPUTrainer(BaseRLTrainer):
     def _learn_loop(self, best_reward, clock):
         results = {}
         fuse = self.config.train.fuse_inner_epoch and self.num_mb == 1
+        fuse_all = self.config.train.fuse_all_inner_epochs and self.num_mb == 1
         for _ in range(self.config.train.epochs):
+            if fuse_all:
+                # every inner epoch in ONE dispatch; host precomputes the
+                # per-epoch reshuffles
+                self._maybe_profile_step()
+                loaders = [
+                    self.create_train_dataloader(seed_offset=i)
+                    for i in range(self.n_inner_epochs)
+                ]
+                stats, n_steps = self.train_inner_epochs_fused(loaders)
+                self.iter_count += n_steps
+                res, best_reward, done = self._post_step(
+                    stats, clock, best_reward, n_steps=n_steps
+                )
+                results = res or results
+                if done:
+                    return results
+                for _ in range(self.n_inner_epochs):
+                    self.post_backward_callback()
+                self.post_epoch_callback()
+                continue
             for _ in range(self.n_inner_epochs):
                 train_dataloader = self.create_train_dataloader()
                 if fuse:

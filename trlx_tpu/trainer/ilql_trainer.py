@@ -262,10 +262,10 @@ class ILQLTrainer(TPUTrainer):
         else:
             self.store = make_experience(samples, rewards, self.tokenizer, max_length)
 
-    def create_train_dataloader(self):
+    def create_train_dataloader(self, seed_offset: int = 0):
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True, drop_last=False,
-            seed=self.config.train.seed + self.iter_count,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 
     def prepare_learning(self):

@@ -149,13 +149,13 @@ class PipelinedSFTTrainer(SFTTrainer):
 
         return loss_fn
 
-    def create_train_dataloader(self):
+    def create_train_dataloader(self, seed_offset: int = 0):
         # drop_last: the GPipe shard_map needs every batch divisible by
         # data x n_microbatches — a ragged tail batch can't be replicated
         # the way the GSPMD trainers fall back to
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True, drop_last=True,
-            seed=self.config.train.seed + self.iter_count,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 
     # ------------------------------------------------------------------

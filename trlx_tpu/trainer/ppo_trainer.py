@@ -457,12 +457,23 @@ class PPOTrainer(TPUTrainer):
     def post_backward_callback(self):
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
 
-    def create_train_dataloader(self):
+    def create_train_dataloader(self, seed_offset: int = 0):
         # seed moves with iter_count so each inner epoch reshuffles (the
-        # reference's torch DataLoader draws from global RNG each epoch)
+        # reference's torch DataLoader draws from global RNG each epoch);
+        # seed_offset distinguishes epochs created up front by the fused path.
+        # Static pad widths from the config keep batch shapes identical
+        # across rollout collections (no train-step recompiles). Queries
+        # are truncated with gen_kwargs' budget (trlx.py max_prompt_length);
+        # responses/stats with the experience budget, which may differ.
+        exp_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        exp_max_new = int(exp_kwargs.get("max_new_tokens", 40))
+        eval_max_new = int(self.generate_kwargs.get("max_new_tokens", 40))
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True,
-            seed=self.config.train.seed + self.iter_count,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
+            max_query_len=self.config.train.seq_length - eval_max_new,
+            max_response_len=exp_max_new + (1 if self.seq2seq else 0),
+            max_stat_len=exp_max_new,
         )
 
     def prepare_learning(self):

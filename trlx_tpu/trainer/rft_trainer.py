@@ -149,10 +149,10 @@ class RFTTrainer(TPUTrainer):
         self.epoch_count += 1
         self.make_experience()
 
-    def create_train_dataloader(self):
+    def create_train_dataloader(self, seed_offset: int = 0):
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True,
-            seed=self.config.train.seed + self.iter_count,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 
     def prepare_learning(self):

@@ -85,10 +85,10 @@ class SFTTrainer(TPUTrainer):
             dialogs = [tokenize_dialogue(d, self.tokenizer, seq_length) for d in samples]
             self.store = DialogStore(dialogs, self.tokenizer)
 
-    def create_train_dataloader(self):
+    def create_train_dataloader(self, seed_offset: int = 0):
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True,
-            seed=self.config.train.seed + self.iter_count,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 
     def prepare_learning(self):

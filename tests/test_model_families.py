@@ -143,6 +143,81 @@ def test_export_round_trip(tmp_path, family, rng):
     assert hf_interop.infer_family(cfg) == family
 
 
+def test_mistral_sliding_window_parity(tmp_path, rng):
+    """Mistral maps to the llama family plus a sliding window; with
+    window < seq the band must match HF's banded attention exactly."""
+    import transformers as tf
+
+    torch.manual_seed(0)
+    hf_model = tf.MistralForCausalLM(tf.MistralConfig(
+        vocab_size=VOCAB, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=6,
+        attn_implementation="eager",
+    ))
+    hf_model.eval()
+    path = str(tmp_path / "mistral")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    cfg = hf_interop.config_from_hf(path, dtype=jnp.float32)
+    assert cfg.sliding_window == 6
+    model = CausalLMWithValueHead(cfg)
+    tokens8 = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens8, jnp.ones_like(tokens8))["params"]
+    params = hf_interop.load_params_from_hf(path, cfg, template)
+
+    tokens = rng.integers(0, VOCAB, size=(2, SEQ))  # SEQ=16 > window=6
+    mask = np.ones((2, SEQ), dtype=np.int64)
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(tokens), attention_mask=torch.tensor(mask)
+        ).logits.numpy()
+    ours, _, _ = model.apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32), jnp.asarray(mask, jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(ours), ref, atol=2e-3, rtol=2e-3)
+
+    # windowed != unwindowed beyond the band (the test actually bites)
+    cfg_nw = hf_interop.config_from_hf(path, dtype=jnp.float32, sliding_window=None)
+    logits_nw, _, _ = CausalLMWithValueHead(cfg_nw).apply(
+        {"params": params}, jnp.asarray(tokens, jnp.int32), jnp.asarray(mask, jnp.int32)
+    )
+    assert not np.allclose(np.asarray(ours)[:, -1], np.asarray(logits_nw)[:, -1], atol=1e-4)
+
+
+def test_sliding_window_decode_matches_forward():
+    """Cached decode applies the same band as the training forward."""
+    from trlx_tpu.models import config_from_preset, init_kv_cache
+    from trlx_tpu.models.transformer import TransformerLM
+
+    cfg = config_from_preset("llama-tiny", vocab_size=64, dtype=jnp.float32,
+                             sliding_window=4)
+    model = TransformerLM(cfg)
+    rng_np = np.random.default_rng(0)
+    tokens = jnp.asarray(rng_np.integers(0, 64, (2, 12)), jnp.int32)
+    mask = jnp.ones_like(tokens)
+    params = model.init(jax.random.PRNGKey(0), tokens, mask)["params"]
+    full_logits, _, _ = model.apply({"params": params}, tokens, mask)
+
+    cache = init_kv_cache(cfg, 2, 12, dtype=jnp.float32)
+    logits, _, cache = model.apply(
+        {"params": params}, tokens[:, :6], cache, mask[:, :6], True,
+        method=TransformerLM.decode_step,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full_logits[:, :6]), atol=1e-4
+    )
+    for i in range(6, 12):
+        logits, _, cache = model.apply(
+            {"params": params}, tokens[:, i:i + 1], cache, mask[:, i:i + 1], False,
+            method=TransformerLM.decode_step,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full_logits[:, i]), atol=1e-4,
+            err_msg=f"step {i}",
+        )
+
+
 def test_preset_coverage():
     """Every family has at least one preset and they build."""
     from trlx_tpu.models.transformer import PRESETS, config_from_preset
@@ -156,3 +231,22 @@ def test_preset_coverage():
         logits, values, _ = model.apply({"params": params}, tokens, jnp.ones_like(tokens))
         assert logits.shape == (1, 8, 64)
         assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_fused_attention_eligibility():
+    from trlx_tpu.models.transformer import TransformerConfig, fused_attention_ok
+
+    base = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=4, d_ff=64)
+    assert not fused_attention_ok(TransformerConfig(**base, attn_impl="xla"), 128)
+    assert fused_attention_ok(TransformerConfig(**base, attn_impl="flash"), 128)
+    # window inactive when seq fits inside it -> fused stays on
+    cfg = TransformerConfig(**base, attn_impl="flash", sliding_window=4096)
+    assert fused_attention_ok(cfg, 2048)
+    assert not fused_attention_ok(cfg, 8192)
+    assert not fused_attention_ok(cfg, None)
+    # ring + window can never be proven inactive locally -> loud error
+    with pytest.raises(NotImplementedError):
+        fused_attention_ok(
+            TransformerConfig(**base, attn_impl="ring", sliding_window=4096), 128
+        )
+    assert not fused_attention_ok(TransformerConfig(**base, attn_impl="flash", alibi=True), 128)

@@ -85,6 +85,8 @@ def config_from_hf(path: str, **overrides) -> TransformerConfig:
             tie_embeddings=bool(hf.get("tie_word_embeddings", False)), use_bias=False,
             rope_theta=hf.get("rope_theta", 10000.0),
             layer_norm_epsilon=hf.get("rms_norm_eps", 1e-6),
+            # Mistral: banded causal attention; plain Llama leaves it None
+            sliding_window=hf.get("sliding_window"),
         )
     elif fam == "gpt_neox":
         kwargs = dict(

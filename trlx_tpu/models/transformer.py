@@ -52,6 +52,7 @@ class TransformerConfig:
     embed_ln: bool = False  # LayerNorm right after token embedding (Bloom)
     attn_bias: Optional[bool] = None  # q/k/v/o bias override; None = use_bias (GPT-J: False)
     lm_head_bias: bool = False  # untied lm_head carries a bias (GPT-J)
+    sliding_window: Optional[int] = None  # banded causal attention (Mistral)
     # HF family tag recorded at conversion time so save_pretrained exports
     # the exact source layout (structure-based inference is ambiguous, e.g.
     # non-MQA GPTBigCode vs GPT-2); None = infer from structure.
@@ -143,6 +144,34 @@ def alibi_bias(key_mask: jnp.ndarray, n_heads: int) -> jnp.ndarray:
     return (slopes[None, :, None, None] * k_pos[:, None, None, :]).astype(jnp.float32)
 
 
+def fused_attention_ok(cfg: TransformerConfig, seq_len: Optional[int] = None) -> bool:
+    """Whether the fused (flash/ring) kernels can express cfg's attention
+    structure for a length-`seq_len` forward. Single source of truth for
+    Attention, TransformerLM._train_bias, and the GPipe stage — the
+    caller's bias=None decision must match Attention's branch exactly.
+
+    A sliding window is a static no-op when seq_len <= window, so the
+    fused path stays available for the common fits-in-window case (e.g.
+    Mistral's 4096 window at 2048-token training). Ring attention shards
+    the sequence, so a configured window can never be proven inactive
+    from the local length — reject loudly instead of silently computing
+    shard-local attention."""
+    if cfg.attn_impl not in ("flash", "ring"):
+        return False
+    if cfg.sliding_window is not None and cfg.attn_impl == "ring":
+        raise NotImplementedError(
+            "sliding_window with ring attention is not supported; use "
+            "attn_impl='xla' or 'flash'"
+        )
+    if cfg.alibi:
+        return False
+    if cfg.sliding_window is not None and (
+        seq_len is None or seq_len > cfg.sliding_window
+    ):
+        return False
+    return True
+
+
 def lora_dense(mod: nn.Module, cfg: TransformerConfig, feats: int, name: str, use_bias: bool):
     """A Dense layer with an optional LoRA adapter (y += x·A·B · α/r).
     Adapter leaves sit beside the base kernel in the param tree
@@ -204,7 +233,7 @@ class Attention(nn.Module):
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv}
 
-        if cfg.attn_impl in ("flash", "ring") and not cfg.alibi and layer_cache is None and attn_mask is not None:
+        if fused_attention_ok(cfg, t) and layer_cache is None and attn_mask is not None:
             # Fused training/scoring path: causal + key-padding structure is
             # computed inside the kernel from `attn_mask`; `attn_bias` is
             # ignored (it encodes exactly that structure, causal_bias below).
@@ -273,14 +302,28 @@ class Block(nn.Module):
         return h, new_cache
 
 
-def causal_bias(attn_mask: jnp.ndarray) -> jnp.ndarray:
-    """Additive attention bias for training: causal + key-padding.
-    attn_mask: [b, t] (1 = real token). Returns [b, 1, t, t] f32."""
+def causal_bias(attn_mask: jnp.ndarray, sliding_window: Optional[int] = None) -> jnp.ndarray:
+    """Additive attention bias for training: causal + key-padding, plus
+    the sliding-window band when set (Mistral: query i attends keys in
+    (i - window, i]). attn_mask: [b, t] (1 = real token). Returns
+    [b, 1, t, t] f32."""
     t = attn_mask.shape[-1]
     causal = jnp.tril(jnp.ones((t, t), dtype=bool))
+    if sliding_window is not None:
+        ids = jnp.arange(t)
+        causal = causal & ((ids[:, None] - ids[None, :]) < sliding_window)
     keymask = attn_mask[:, None, None, :].astype(bool)
     allowed = causal[None, None, :, :] & keymask
     return jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
+
+
+def window_bias(q_positions: jnp.ndarray, key_mask: jnp.ndarray, window: int) -> jnp.ndarray:
+    """Additive sliding-window term for cached decode: forbid keys whose
+    position trails the query by >= window. q_positions: [b, t];
+    key_mask: [b, S] validity. Returns [b, 1, t, S] f32."""
+    k_pos = jnp.clip(jnp.cumsum(key_mask.astype(jnp.int32), axis=-1) - 1, 0, None)
+    delta = q_positions[:, :, None] - k_pos[:, None, :]  # [b, t, S]
+    return jnp.where(delta >= window, -1e9, 0.0)[:, None].astype(jnp.float32)
 
 
 def decode_bias(cache_mask: jnp.ndarray, t: int) -> jnp.ndarray:
@@ -357,6 +400,19 @@ class TransformerLM(nn.Module):
             )
         return position_ids(attn_mask)
 
+    def _train_bias(self, attn_mask):
+        """Additive bias for the no-cache forward, or None when a fused
+        kernel builds the structure itself (fused paths cover plain
+        causal only — ALiBi and active sliding windows need the dense
+        bias)."""
+        cfg = self.cfg
+        if fused_attention_ok(cfg, attn_mask.shape[-1]):
+            return None
+        bias = causal_bias(attn_mask, cfg.sliding_window)
+        if cfg.alibi:
+            bias = bias + alibi_bias(attn_mask, cfg.n_heads)
+        return bias
+
     def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None):
         new_layers = [] if cache is not None else None
         for i in range(start, stop):
@@ -377,12 +433,7 @@ class TransformerLM(nn.Module):
         h_final) where h_split is the activation entering block `split`."""
         if positions is None:
             positions = self._default_positions(tokens, attn_mask)
-        fused = self.cfg.attn_impl in ("flash", "ring") and not self.cfg.alibi
-        # Fused kernels build causal+padding structure from attn_mask
-        # blockwise — skip materializing the O(t^2) bias tensor entirely.
-        bias = None if fused else causal_bias(attn_mask)
-        if bias is not None and self.cfg.alibi:
-            bias = bias + alibi_bias(attn_mask, self.cfg.n_heads)
+        bias = self._train_bias(attn_mask)
         h = self.embed(tokens, positions)
         h, _ = self.run_blocks(h, bias, positions, 0, split, attn_mask=attn_mask)
         h_split = h
@@ -402,10 +453,7 @@ class TransformerLM(nn.Module):
         modeling_ppo.py:410-453) when applied with reference params."""
         if positions is None:
             positions = self._default_positions(h, attn_mask)
-        fused = self.cfg.attn_impl in ("flash", "ring") and not self.cfg.alibi
-        bias = None if fused else causal_bias(attn_mask)
-        if bias is not None and self.cfg.alibi:
-            bias = bias + alibi_bias(attn_mask, self.cfg.n_heads)
+        bias = self._train_bias(attn_mask)
         h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers, attn_mask=attn_mask)
         logits, _ = self.unembed(h)
         return logits
@@ -435,6 +483,8 @@ class TransformerLM(nn.Module):
         bias = decode_bias(new_mask, t)
         if self.cfg.alibi:
             bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
+        if self.cfg.sliding_window is not None:
+            bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
         if is_prefill:
             # causal structure within the prefill block
             S = cache["mask"].shape[-1]

@@ -44,6 +44,7 @@ from trlx_tpu.models.transformer import (
     TransformerConfig,
     alibi_bias,
     causal_bias,
+    fused_attention_ok,
     position_ids,
 )
 
@@ -142,10 +143,9 @@ def gpipe_blocks(
     def stage(x, mask):
         positions = position_ids(mask)
         # Fused attention impls build causal+padding structure blockwise
-        # from the mask — skip the O(t^2) bias tensor (as in
-        # TransformerLM.__call__; ALiBi needs the dense-bias path).
-        fused = cfg.attn_impl in ("flash", "ring") and not cfg.alibi
-        bias = None if fused else causal_bias(mask)
+        # from the mask — skip the O(t^2) bias tensor (shared eligibility
+        # predicate with Attention / TransformerLM._train_bias).
+        bias = None if fused_attention_ok(cfg, mask.shape[-1]) else causal_bias(mask, cfg.sliding_window)
         if bias is not None and cfg.alibi:
             bias = bias + alibi_bias(mask, cfg.n_heads)
         return _apply_layer_stack(cfg, my_layers, x, bias, positions, mask)

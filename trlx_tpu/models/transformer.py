@@ -317,6 +317,19 @@ def causal_bias(attn_mask: jnp.ndarray, sliding_window: Optional[int] = None) ->
     return jnp.where(allowed, 0.0, -1e9).astype(jnp.float32)
 
 
+def train_bias(cfg: TransformerConfig, attn_mask: jnp.ndarray) -> Optional[jnp.ndarray]:
+    """Additive bias for a no-cache forward, or None when a fused kernel
+    builds the structure itself (fused paths cover plain causal only —
+    ALiBi and active sliding windows need the dense bias). The single
+    bias-construction policy for TransformerLM and the GPipe stage."""
+    if fused_attention_ok(cfg, attn_mask.shape[-1]):
+        return None
+    bias = causal_bias(attn_mask, cfg.sliding_window)
+    if cfg.alibi:
+        bias = bias + alibi_bias(attn_mask, cfg.n_heads)
+    return bias
+
+
 def window_bias(q_positions: jnp.ndarray, key_mask: jnp.ndarray, window: int) -> jnp.ndarray:
     """Additive sliding-window term for cached decode: forbid keys whose
     position trails the query by >= window. q_positions: [b, t];
@@ -401,17 +414,7 @@ class TransformerLM(nn.Module):
         return position_ids(attn_mask)
 
     def _train_bias(self, attn_mask):
-        """Additive bias for the no-cache forward, or None when a fused
-        kernel builds the structure itself (fused paths cover plain
-        causal only — ALiBi and active sliding windows need the dense
-        bias)."""
-        cfg = self.cfg
-        if fused_attention_ok(cfg, attn_mask.shape[-1]):
-            return None
-        bias = causal_bias(attn_mask, cfg.sliding_window)
-        if cfg.alibi:
-            bias = bias + alibi_bias(attn_mask, cfg.n_heads)
-        return bias
+        return train_bias(self.cfg, attn_mask)
 
     def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None):
         new_layers = [] if cache is not None else None

@@ -42,10 +42,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from trlx_tpu.models.transformer import (
     Block,
     TransformerConfig,
-    alibi_bias,
-    causal_bias,
-    fused_attention_ok,
     position_ids,
+    train_bias,
 )
 
 PIPE_AXIS = "pipe"
@@ -142,12 +140,9 @@ def gpipe_blocks(
 
     def stage(x, mask):
         positions = position_ids(mask)
-        # Fused attention impls build causal+padding structure blockwise
-        # from the mask — skip the O(t^2) bias tensor (shared eligibility
-        # predicate with Attention / TransformerLM._train_bias).
-        bias = None if fused_attention_ok(cfg, mask.shape[-1]) else causal_bias(mask, cfg.sliding_window)
-        if bias is not None and cfg.alibi:
-            bias = bias + alibi_bias(mask, cfg.n_heads)
+        # shared bias policy with TransformerLM (None => fused kernel
+        # builds causal+padding structure blockwise, no O(t^2) tensor)
+        bias = train_bias(cfg, mask)
         return _apply_layer_stack(cfg, my_layers, x, bias, positions, mask)
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound

@@ -292,12 +292,28 @@ class PPOTrainer(TPUTrainer):
         gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
         max_new = int(gen_kwargs.get("max_new_tokens", 40))
 
+        # Double-buffered generation: the NEXT chunk's sampling is
+        # dispatched before the current chunk's device->host sync, so the
+        # host-side decode/reward/element work runs while the device is
+        # already generating ahead (params are fixed for the whole
+        # collection, so this changes no semantics). Each chunk appends
+        # exactly one element per prompt, so "will another chunk be
+        # needed" is decidable before processing this one.
+        def _dispatch_next():
+            b = next(self.prompt_iterator)
+            return b, self.generate(b["input_ids"], b["attention_mask"], gen_kwargs)
+
+        pending = _dispatch_next()
+
         while len(ppo_rl_elements) < num_rollouts:
             stats: Dict[str, float] = {}
-            batch = next(self.prompt_iterator)
+            batch, out = pending
+            pending = None
+            n_this = len(np.asarray(batch["input_ids"]))
+            if len(ppo_rl_elements) + n_this < num_rollouts:
+                pending = _dispatch_next()
 
             clock.tick()  # reset timer
-            out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
             samples = np.asarray(out["samples"])  # materialize (also syncs device)
             stats["time/rollout_generate"] = clock.tick()
 

@@ -200,7 +200,9 @@ class S2SMLP(nn.Module):
         dense = lambda feats, name: nn.Dense(
             feats, use_bias=cfg.use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name
         )
-        act = {"relu": jax.nn.relu, "silu": jax.nn.silu}.get(cfg.activation, jax.nn.gelu)
+        from trlx_tpu.models.transformer import activation_fn
+
+        act = activation_fn(cfg)
         if cfg.glu:
             gated = act(dense(cfg.d_ff, "gate_proj")(h)) * dense(cfg.d_ff, "up_proj")(h)
             return dense(cfg.d_model, "down_proj")(gated)

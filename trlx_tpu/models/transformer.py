@@ -53,6 +53,27 @@ class TransformerConfig:
     attn_bias: Optional[bool] = None  # q/k/v/o bias override; None = use_bias (GPT-J: False)
     lm_head_bias: bool = False  # untied lm_head carries a bias (GPT-J)
     sliding_window: Optional[int] = None  # banded causal attention (Mistral)
+    # Mixture-of-experts MLP (BEYOND the reference, whose §2.7 EP row is
+    # empty): 0 = dense MLP. Experts are a leading param dim sharded over
+    # the `tensor` mesh axis (expert parallelism); routing is top-k
+    # token-choice with renormalized gates. Dispatch is dense (every
+    # expert computes every token, non-selected contributions masked) —
+    # simple, static-shaped, and collective-free; at large expert counts
+    # a sorted all-to-all dispatch would trade that simplicity for FLOPs.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    # Switch-style load-balancing coefficient: aux = coef * E * sum_e
+    # (fraction routed to e) * (mean router prob of e), sown by MoEMLP and
+    # added to the training loss (plain top-k routing collapses onto a
+    # few experts without it).
+    moe_aux_coef: float = 0.01
+
+    def __post_init__(self):
+        if self.moe_experts > 0 and self.lora_rank > 0:
+            raise NotImplementedError(
+                "LoRA adapters on MoE expert weights are not supported; "
+                "set moe_experts=0 or lora_rank=0"
+            )
     # HF family tag recorded at conversion time so save_pretrained exports
     # the exact source layout (structure-based inference is ambiguous, e.g.
     # non-MQA GPTBigCode vs GPT-2); None = infer from structure.
@@ -84,6 +105,15 @@ class TransformerConfig:
     def rotary_dim(self) -> int:
         rd = int(self.head_dim * self.rotary_pct)
         return rd - (rd % 2)
+
+
+def activation_fn(cfg: TransformerConfig):
+    """cfg.activation -> callable (single source for MLP/MoEMLP/seq2seq)."""
+    return {
+        "silu": jax.nn.silu,
+        "relu": jax.nn.relu,
+        "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+    }.get(cfg.activation, jax.nn.gelu)
 
 
 def make_norm(cfg: TransformerConfig, name: str):
@@ -271,15 +301,75 @@ class MLP(nn.Module):
     def __call__(self, h):
         cfg = self.cfg
         dense = lambda feats, name: lora_dense(self, cfg, feats, name, cfg.use_bias)
-        act = {
-            "silu": jax.nn.silu,
-            "relu": jax.nn.relu,
-            "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
-        }.get(cfg.activation, jax.nn.gelu)
+        act = activation_fn(cfg)
         if cfg.glu:
             gated = act(dense(cfg.d_ff, "gate_proj")(h)) * dense(cfg.d_ff, "up_proj")(h)
             return dense(cfg.d_model, "down_proj")(gated)
         return dense(cfg.d_model, "down_proj")(act(dense(cfg.d_ff, "up_proj")(h)))
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP: router -> top-k gates -> per-expert FFN mix.
+    Expert params carry a leading [n_experts] dim (sharded over `tensor`
+    by the rule table), so each device holds E/tp experts and XLA psums
+    the masked partial outputs."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.cfg
+        E, k, d, f = cfg.moe_experts, cfg.moe_top_k, cfg.d_model, cfg.d_ff
+        act = activation_fn(cfg)
+
+        gate_logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32, param_dtype=cfg.param_dtype, name="router"
+        )(h)  # [b, t, E] — routing in f32 for stable softmax
+        probs = jax.nn.softmax(gate_logits, axis=-1)
+        top_w, top_i = jax.lax.top_k(probs, k)
+        top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)  # renormalize
+        gates = jnp.zeros_like(probs)
+        selected = jnp.zeros_like(probs)
+        for j in range(k):  # static tiny loop: scatter top-k gates back to [b,t,E]
+            onehot = jax.nn.one_hot(top_i[..., j], E, dtype=probs.dtype)
+            gates = gates + top_w[..., j, None] * onehot
+            selected = selected + onehot
+
+        # Switch-style load-balancing signal, consumed by the trainers'
+        # loss fns via mutable "intermediates" (collect_moe_aux_loss)
+        frac_routed = selected.reshape(-1, E).mean(0)  # [E]
+        mean_prob = probs.reshape(-1, E).mean(0)
+        self.sow("intermediates", "moe_aux", E * jnp.sum(frac_routed * mean_prob))
+
+        # batch_axis keeps fan_in = d per expert (a plain 3D lecun_normal
+        # would divide variance by E*d, starting experts sqrt(E) too small)
+        init = nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal", in_axis=-2, out_axis=-1, batch_axis=(0,)
+        )
+        up = self.param("up_proj", init, (E, d, f), cfg.param_dtype)
+        down = self.param("down_proj", init, (E, f, d), cfg.param_dtype)
+        h_c = h.astype(cfg.dtype)
+        hidden = jnp.einsum("btd,edf->btef", h_c, up.astype(cfg.dtype))
+        if cfg.use_bias:
+            up_b = self.param("up_bias", nn.initializers.zeros, (E, f), cfg.param_dtype)
+            hidden = hidden + up_b.astype(cfg.dtype)[None, None]
+        if cfg.glu:
+            gate_w = self.param("gate_proj", init, (E, d, f), cfg.param_dtype)
+            hidden = act(jnp.einsum("btd,edf->btef", h_c, gate_w.astype(cfg.dtype))) * hidden
+        else:
+            hidden = act(hidden)
+        out = jnp.einsum("btef,efd->bted", hidden, down.astype(cfg.dtype))
+        if cfg.use_bias:
+            down_b = self.param("down_bias", nn.initializers.zeros, (E, d), cfg.param_dtype)
+            out = out + down_b.astype(cfg.dtype)[None, None]
+        return jnp.einsum("bte,bted->btd", gates.astype(cfg.dtype), out)
+
+
+def moe_aux_from_intermediates(state) -> jnp.ndarray:
+    """Sum the moe_aux scalars sown by every MoEMLP during a
+    mutable=['intermediates'] apply; 0 when nothing was sown."""
+    leaves = jax.tree_util.tree_leaves(state.get("intermediates", {}))
+    return sum(leaves) if leaves else jnp.asarray(0.0, jnp.float32)
 
 
 class Block(nn.Module):
@@ -292,13 +382,14 @@ class Block(nn.Module):
         attn_out, new_cache = Attention(cfg, name="attn")(
             h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask
         )
+        mlp_cls = MoEMLP if cfg.moe_experts > 0 else MLP
         if cfg.parallel_residual:
             # GPT-NeoX: x + attn(ln1(x)) + mlp(ln2(x)); GPT-J shares ln1.
             mlp_in = h_ln if cfg.shared_ln else make_norm(cfg, "ln_mlp")(h)
-            h = h + attn_out + MLP(cfg, name="mlp")(mlp_in)
+            h = h + attn_out + mlp_cls(cfg, name="mlp")(mlp_in)
         else:
             h = h + attn_out
-            h = h + MLP(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
+            h = h + mlp_cls(cfg, name="mlp")(make_norm(cfg, "ln_mlp")(h))
         return h, new_cache
 
 

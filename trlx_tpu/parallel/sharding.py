@@ -103,6 +103,12 @@ GPT_RULES = ShardingRules(
         # same output sharding as the base projection it adds into.
         (r"\w+_lora_a", ("fsdp", None)),
         (r"\w+_lora_b", (None, "tensor")),
+        # MoE: expert dim over `tensor` (expert parallelism); router
+        # replicated so every device can gate every token.
+        (r"mlp/router/kernel", (None, None)),
+        (r"mlp/(up_proj|gate_proj)$", ("tensor", "fsdp", None)),
+        (r"mlp/down_proj$", ("tensor", None, "fsdp")),
+        (r"mlp/(up_bias|down_bias)$", ("tensor", None)),
         (r"(ln_\w+|norm\w*|layernorm)/(scale|bias)", (None,)),
         # value / Q heads: first layer column-split, output layer replicated
         (r"(v_head|q_head|target_q_head)\w*/dense_in/kernel", ("fsdp", "tensor")),

@@ -198,9 +198,21 @@ class PPOTrainer(TPUTrainer):
             tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
             attention_mask = (tokens != pad_id).astype(jnp.int32)
             positions = position_ids(attention_mask)
-            logits, values_pred, _ = model.apply(
-                {"params": params}, tokens, attention_mask, positions
-            )
+            moe_aux = 0.0
+            if getattr(self.model_cfg, "moe_experts", 0) > 0:
+                from trlx_tpu.models.transformer import moe_aux_from_intermediates
+
+                (logits, values_pred, _), inter = model.apply(
+                    {"params": params}, tokens, attention_mask, positions,
+                    mutable=["intermediates"],
+                )
+                moe_aux = getattr(self.model_cfg, "moe_aux_coef", 0.0) * (
+                    moe_aux_from_intermediates(inter)
+                )
+            else:
+                logits, values_pred, _ = model.apply(
+                    {"params": params}, tokens, attention_mask, positions
+                )
             values_pred = values_pred[:, :-1]
             logprobs = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
 
@@ -210,7 +222,7 @@ class PPOTrainer(TPUTrainer):
             values_pred = values_pred[:, start:end]
             mask = attention_mask[:, start + 1 : end + 1]
 
-            return ppo_loss(
+            loss, stats = ppo_loss(
                 logprobs=logprobs,
                 values=values_pred,
                 old_logprobs=old_logprobs,
@@ -222,6 +234,8 @@ class PPOTrainer(TPUTrainer):
                 cliprange_value=method.cliprange_value,
                 vf_coef=method.vf_coef,
             )
+            loss = loss + moe_aux
+            return loss, stats
 
         return loss_fn
 

@@ -167,3 +167,65 @@ def test_pipelined_sft_trainer(tmp_path):
     import os
 
     assert os.path.exists(str(tmp_path / "hf" / "pytorch_model.bin"))
+
+
+def test_pipelined_ilql_trainer(tmp_path):
+    """PipelinedILQLTrainer: offline RL through the GPipe program (the
+    NeMo ILQL role) — runs end-to-end via the public train() API,
+    matches the plain ILQL trainer's loss on identical params/batch,
+    target-Q Polyak sync works on the stacked layout."""
+    import numpy as np
+
+    import jax
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_ilql_config
+
+    def make_config(trainer, pipeline, sub):
+        return default_ilql_config().evolve(
+            model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                       model_extra_configs=dict(dtype="float32")),
+            tokenizer=dict(tokenizer_path="byte"),
+            train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                       eval_interval=10, checkpoint_interval=100, trainer=trainer,
+                       checkpoint_dir=str(tmp_path / sub), seed=5),
+            method=dict(steps_for_target_q_sync=1, alpha=1.0,
+                        gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0,
+                                        temperature=1.0)),
+            parallel=dict(data=8 // pipeline if pipeline > 1 else 1,
+                          fsdp=1, tensor=1, pipeline=pipeline),
+        )
+
+    samples = [("ask", " yes"), ("ask", " no"), ("q", " maybe"), ("q", " sure")] * 4
+    rewards = [1.0, -1.0, 0.5, 0.2] * 4
+
+    trainer = trlx.train(
+        samples=samples, rewards=rewards, eval_prompts=["ask", "q"],
+        config=make_config("PipelinedILQLTrainer", 2, "pp"),
+    )
+    assert trainer.iter_count >= 2
+
+    # target heads synced (alpha=1 + sync every step => equal to q heads)
+    heads = trainer.params["ilql_heads"]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(heads["q_head_0"]),
+        jax.tree_util.tree_leaves(heads["target_q_head_0"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    # loss parity vs the plain trainer on identical params/batch
+    from flax import traverse_util
+    from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+
+    plain = ILQLTrainer(make_config("ILQLTrainer", 1, "plain"),
+                        devices=jax.devices()[:1])
+    batch = next(iter(trainer.store.create_loader(8, shuffle=False, drop_last=True)))
+    pp_loss, _ = trainer.make_loss_fn()(
+        traverse_util.flatten_dict(dict(trainer.params)), {},
+        trainer.batch_to_device(batch),
+    )
+    plain_loss, _ = plain.make_loss_fn()(
+        traverse_util.flatten_dict(trainer.standard_params()), {}, batch
+    )
+    np.testing.assert_allclose(
+        float(jax.device_get(pp_loss)), float(jax.device_get(plain_loss)), rtol=1e-4
+    )

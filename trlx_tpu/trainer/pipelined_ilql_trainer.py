@@ -1,0 +1,78 @@
+"""Pipeline-parallel ILQL trainer.
+
+Parity: the reference's NeMoILQLTrainer/ILQLGPT path — offline RL driven
+through the Apex pipeline engine with ParallelILQLHeads on the last PP
+stage and SP gathers before the index selects
+(nemo_ilql_trainer.py:101-204, modeling_nemo_ilql.py:255-785). Here the
+LM trunk runs as the same stacked GPipe program the pipelined SFT trainer
+uses, the final hidden state comes back replicated, and the ILQL heads +
+index selects + loss run on it directly — no last-stage special casing,
+no SP gathers, no loss broadcast from the last rank.
+
+Enable with:
+    train.trainer: "PipelinedILQLTrainer"
+    parallel: {data: D, pipeline: S}
+"""
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data import ILQLBatch
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.heads import ILQLHeads
+from trlx_tpu.models import target_q_mask
+from trlx_tpu.ops.ilql import ilql_loss
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import merge_params
+from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+from trlx_tpu.trainer.pipelined_mixin import PipelinedCausalMixin
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
+    def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
+        self._validate_pipeline_config(config)
+        self._n_microbatches = n_microbatches
+        super().__init__(config, **kwargs)
+
+    def make_trainable_mask(self, params) -> Dict:
+        # target-Q heads learn only via Polyak sync, not the optimizer
+        mask = PipelinedCausalMixin.make_trainable_mask(self, params)
+        tq = target_q_mask(params)
+        return jax.tree_util.tree_map(lambda m, t: bool(m) and not bool(t), mask, tq)
+
+    def generate(self, input_ids, attention_mask, gen_kwargs=None, mode: str = "ilql"):
+        # Q-guided sampling on the unstacked view (beta * (Q - V) shift)
+        return PipelinedCausalMixin.generate(self, input_ids, attention_mask, gen_kwargs, mode)
+
+    def make_loss_fn(self) -> Callable:
+        cfg = self.ilql
+        fwd = self.make_stacked_lm_forward(with_hidden=True)
+        heads = ILQLHeads(
+            self.model_cfg.vocab_size, cfg.two_qs,
+            self.model_cfg.dtype, self.model_cfg.param_dtype,
+        )
+
+        def loss_fn(train_params, frozen_params, batch: ILQLBatch):
+            params = merge_params(train_params, frozen_params)
+            logits, h_final = fwd(
+                params["lm_stacked"], params["lm_rest"],
+                batch.input_ids, batch.attention_mask,
+            )
+            qs, target_qs, vs = heads.apply(
+                {"params": params["ilql_heads"]}, h_final,
+                batch.states_ixs, batch.actions_ixs,
+            )
+            return ilql_loss(
+                logits, qs, target_qs, vs,
+                batch.input_ids, batch.actions_ixs, batch.dones, batch.rewards,
+                tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
+                awac_scale=cfg.awac_scale, beta=cfg.beta,
+            )
+
+        return loss_fn

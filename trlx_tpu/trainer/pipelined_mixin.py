@@ -1,0 +1,158 @@
+"""Shared machinery for pipeline-parallel trainers (GPipe over a
+("data", "pipe") mesh with permanently stacked block params).
+
+Mix in FIRST so its overrides win the MRO over the method trainer's:
+
+    class PipelinedXTrainer(PipelinedCausalMixin, XTrainer): ...
+
+The mixin owns param layout ({"lm_stacked", "lm_rest", <heads>}),
+mask/base placement, drop_last loaders (shard_map cannot replicate a
+ragged tail), generation/export on a per-step-cached unstacked view, and
+the stacked GPipe forward builder. Method trainers add their loss.
+See trlx_tpu/trainer/pipelined_sft_trainer.py for the design rationale
+vs the reference's NeMo/Apex pipeline engine.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.parallel.mesh import PipeMeshRuntime
+from trlx_tpu.parallel.pipeline import (
+    make_gpipe_forward_stacked,
+    stack_block_params,
+    unstack_block_params,
+)
+from trlx_tpu.trainer.base_trainer import merge_params
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class PipelinedCausalMixin:
+    def _validate_pipeline_config(self, config: TRLConfig):
+        if getattr(config.parallel, "pipeline", 1) <= 1:
+            raise ValueError(f"{type(self).__name__} requires parallel.pipeline > 1")
+        if config.model.model_arch_type != "causal":
+            raise NotImplementedError("pipeline parallelism covers causal models")
+        if config.model.num_layers_unfrozen != -1:
+            raise NotImplementedError(
+                "layer freezing under pipeline parallelism is not supported; "
+                "set model.num_layers_unfrozen = -1"
+            )
+        if config.model.peft_config is not None:
+            raise NotImplementedError("LoRA under pipeline parallelism is not supported yet")
+
+    # ------------------------------------------------------------------
+    # Param layout: {"lm_stacked", "lm_rest", <heads...>}
+    # ------------------------------------------------------------------
+
+    def place_params(self, params) -> Dict:
+        runtime: PipeMeshRuntime = self.runtime
+        assert isinstance(runtime, PipeMeshRuntime)
+        n_stages = runtime.n_stages
+        cfg = self.model_cfg
+        if getattr(self, "_n_microbatches", None) is None:
+            self._n_microbatches = n_stages
+        stacked, rest = stack_block_params(params["lm"], cfg.n_layers, n_stages)
+        placed = {
+            "lm_stacked": jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, runtime.pipe_sharding), stacked
+            ),
+            "lm_rest": jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, runtime.replicated), rest
+            ),
+        }
+        for k, v in params.items():
+            if k != "lm":
+                placed[k] = jax.tree_util.tree_map(
+                    lambda x: jax.device_put(x, runtime.replicated), v
+                )
+        n_stage_params = sum(
+            int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(stacked)
+        ) // n_stages
+        logger.info(
+            f"Pipelined params: {n_stages} stages x {cfg.n_layers // n_stages} "
+            f"layers, ~{n_stage_params:,} block params per stage"
+        )
+        return placed
+
+    def make_trainable_mask(self, params) -> Dict:
+        # everything trainable under PP (num_layers_unfrozen == -1 is
+        # enforced); method trainers refine by calling this explicitly
+        # and masking their heads on top
+        return jax.tree_util.tree_map(lambda _: True, params)
+
+    def make_stacked_lm_forward(self, with_hidden: bool = False):
+        """fn(stacked, rest, tokens, mask) through the GPipe program, on a
+        fresh TransformerLM module (definitions are pure)."""
+        from trlx_tpu.models.transformer import TransformerLM
+
+        return make_gpipe_forward_stacked(
+            TransformerLM(self.model_cfg), self.model_cfg, self.runtime.mesh,
+            n_microbatches=self._n_microbatches, with_hidden=with_hidden,
+        )
+
+    def standard_params(self) -> Dict:
+        """Unstacked view in the regular model layout (for generation,
+        HF export, and interop). Cached per optimizer step — evaluate()
+        calls generate once per eval batch (x sweep values) and must not
+        re-materialize the full model each time."""
+        cached = getattr(self, "_std_params_cache", None)
+        if cached is not None and cached[0] == self.iter_count:
+            return cached[1]
+        params = merge_params(self.train_params, self.frozen_params)
+        lm = unstack_block_params(
+            params["lm_stacked"], params["lm_rest"], self.model_cfg.n_layers
+        )
+        out = {"lm": lm}
+        for k, v in params.items():
+            if k not in ("lm_stacked", "lm_rest"):
+                out[k] = v
+        self._std_params_cache = (self.iter_count, out)
+        return out
+
+    # ------------------------------------------------------------------
+    # Loaders / generation / export
+    # ------------------------------------------------------------------
+
+    def create_train_dataloader(self, seed_offset: int = 0):
+        # drop_last: the GPipe shard_map needs every batch divisible by
+        # data x n_microbatches — a ragged tail batch can't be replicated
+        # the way the GSPMD trainers fall back to
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=True,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
+        )
+
+    def generate(self, input_ids, attention_mask, gen_kwargs=None, mode: str = "lm"):
+        gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
+        input_ids = np.asarray(input_ids)
+        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode)
+        return fn(
+            self.standard_params(), jnp.asarray(input_ids),
+            jnp.asarray(np.asarray(attention_mask)), self.next_rng(),
+        )
+
+    def evaluate(self):
+        try:
+            return super().evaluate()
+        finally:
+            # release the replicated unstacked copy: it must not occupy
+            # HBM during training steps on models that only fit sharded
+            self._std_params_cache = None
+
+    def save_pretrained(self, directory: Optional[str] = None, **kwargs):
+        # export the standard layout (same HF interop path as every trainer)
+        from flax import traverse_util
+
+        stacked_train, stacked_frozen = self.train_params, self.frozen_params
+        standard = traverse_util.flatten_dict(self.standard_params())
+        self.train_params, self.frozen_params = standard, {}
+        try:
+            super().save_pretrained(directory, **kwargs)
+        finally:
+            self.train_params, self.frozen_params = stacked_train, stacked_frozen

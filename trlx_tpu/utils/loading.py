@@ -21,6 +21,7 @@ for _mod in (
     "trlx_tpu.trainer.ilql_trainer",
     "trlx_tpu.trainer.rft_trainer",
     "trlx_tpu.trainer.pipelined_sft_trainer",
+    "trlx_tpu.trainer.pipelined_ilql_trainer",
 ):
     try:
         __import__(_mod)

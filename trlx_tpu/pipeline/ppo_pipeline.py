@@ -61,6 +61,7 @@ class PPORolloutStorage(BaseRolloutStore):
         max_query_len: int = 0,
         max_response_len: int = 0,
         max_stat_len: int = 0,
+        drop_last: bool = False,
     ) -> DataLoader:
         """Loader with padded-batch collation. Passing the max_*_len
         widths makes batch shapes STATIC across rollout collections (the
@@ -94,5 +95,6 @@ class PPORolloutStorage(BaseRolloutStore):
             )
 
         return DataLoader(
-            self.history, batch_size, shuffle=shuffle, collate_fn=collate, seed=seed
+            self.history, batch_size, shuffle=shuffle, collate_fn=collate,
+            seed=seed, drop_last=drop_last,
         )

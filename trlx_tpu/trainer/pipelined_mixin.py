@@ -45,6 +45,14 @@ class PipelinedCausalMixin:
             )
         if config.model.peft_config is not None:
             raise NotImplementedError("LoRA under pipeline parallelism is not supported yet")
+        if (config.model.model_extra_configs or {}).get("moe_experts", 0) > 0:
+            # the MoE load-balancing loss is sown via flax intermediates,
+            # which don't cross the GPipe shard_map — training would
+            # silently lose routing pressure
+            raise NotImplementedError(
+                "MoE under pipeline parallelism is not supported yet "
+                "(the load-balancing aux loss cannot cross the pipeline program)"
+            )
 
     # ------------------------------------------------------------------
     # Param layout: {"lm_stacked", "lm_rest", <heads...>}

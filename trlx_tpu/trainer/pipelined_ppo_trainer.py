@@ -1,0 +1,154 @@
+"""Pipeline-parallel PPO trainer.
+
+Parity: the reference's NeMoPPOTrainer/PPOGPT path — PPO driven through
+the Apex pipeline engine with a pinned-memory weight-swap reference model
+and a double pipeline pass for logprob/value/ref precompute
+(nemo_ppo_trainer.py:37-441, modeling_nemo_ppo.py:1095-1156). TPU-native
+design:
+
+- TRAIN loss runs as the stacked GPipe shard_map program (logits +
+  replicated final hidden -> value head), like the other pipelined
+  trainers;
+- the rollout scorer makes TWO pipelined passes — policy(+value), then
+  the frozen reference — the same schedule as NeMo's
+  infer_logprobs_and_values, but the reference lives as a second stacked
+  param tree sharded over the pipe axis instead of CPU<->GPU weight
+  swaps;
+- generation uses the sampling engine on a per-collection-cached
+  unstacked view (NeMo instead decodes through the pipeline every token;
+  we trade replicated-generation memory for a single-program decoder —
+  models that only fit sharded should lower chunk_size/eval cadence).
+
+Enable with:
+    train.trainer: "PipelinedPPOTrainer"
+    parallel: {data: D, pipeline: S}
+
+num_layers_unfrozen must be -1 (everything trainable; the frozen
+reference is the full stacked copy, split 0).
+"""
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.data import PPORLBatch
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.heads import MLPHead
+from trlx_tpu.ops.ppo import get_advantages_and_returns, ppo_loss
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import merge_params
+from trlx_tpu.trainer.pipelined_mixin import PipelinedCausalMixin
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.modeling import logprobs_of_labels
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
+    def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
+        self._validate_pipeline_config(config)
+        self._n_microbatches = n_microbatches
+        super().__init__(config, **kwargs)
+
+    # ------------------------------------------------------------------
+    # Frozen reference: a stacked copy sharded over the pipe axis
+    # (replaces PPOTrainer.__init__'s ref_param_subtree on the standard
+    # layout, which this layout cannot feed)
+    # ------------------------------------------------------------------
+
+    def _build_ref_params(self):
+        """Frozen reference = a second stacked copy sharded over the pipe
+        axis (the NeMo path's RefLMHeads weight-swap role, without the
+        CPU<->GPU swaps)."""
+        params = merge_params(self.train_params, self.frozen_params)
+        return jax.tree_util.tree_map(
+            jnp.copy, {"lm_stacked": params["lm_stacked"], "lm_rest": params["lm_rest"]}
+        )
+
+    def _head_module(self):
+        return MLPHead(1, self.model_cfg.dtype, self.model_cfg.param_dtype)
+
+    # ------------------------------------------------------------------
+    # Loss through the GPipe program
+    # ------------------------------------------------------------------
+
+    def make_loss_fn(self) -> Callable:
+        method = self.config.method
+        pad_id = self.tokenizer.pad_token_id
+        fwd = self.make_stacked_lm_forward(with_hidden=True)
+        v_head = self._head_module()
+
+        def loss_fn(train_params, frozen_params, batch: PPORLBatch):
+            params = merge_params(train_params, frozen_params)
+            query_tensors = batch.query_tensors
+            response_tensors = batch.response_tensors
+            response_length = batch.rewards.shape[1]
+
+            advantages, returns = get_advantages_and_returns(
+                batch.values, batch.rewards, method.gamma, method.lam
+            )
+
+            tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
+            attention_mask = (tokens != pad_id).astype(jnp.int32)
+            logits, h_final = fwd(
+                params["lm_stacked"], params["lm_rest"], tokens, attention_mask
+            )
+            values_pred = v_head.apply({"params": params["v_head"]}, h_final)[..., 0]
+            values_pred = values_pred[:, :-1]
+            logprobs = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
+
+            start = query_tensors.shape[1] - 1
+            end = start + response_length
+            return ppo_loss(
+                logprobs=logprobs[:, start:end],
+                values=values_pred[:, start:end],
+                old_logprobs=batch.logprobs,
+                old_values=batch.values,
+                advantages=advantages,
+                returns=returns,
+                mask=attention_mask[:, start + 1 : end + 1],
+                cliprange=method.cliprange,
+                cliprange_value=method.cliprange_value,
+                vf_coef=method.vf_coef,
+            )
+
+        return loss_fn
+
+    # ------------------------------------------------------------------
+    # Rollout scorer: double pipelined pass (policy+value, then reference)
+    # ------------------------------------------------------------------
+
+    def _build_score_fn(self):
+        pad_id = self.tokenizer.pad_token_id
+        fwd = self.make_stacked_lm_forward(with_hidden=True)
+        v_head = self._head_module()
+
+        def score(train_params, frozen_params, ref_params, all_tokens):
+            params = merge_params(train_params, frozen_params)
+            attention_mask = (all_tokens != pad_id).astype(jnp.int32)
+            logits, h_final = fwd(
+                params["lm_stacked"], params["lm_rest"], all_tokens, attention_mask
+            )
+            values = v_head.apply({"params": params["v_head"]}, h_final)[..., 0]
+            ref_logits, _ = fwd(
+                ref_params["lm_stacked"], ref_params["lm_rest"], all_tokens, attention_mask
+            )
+            ref_logits = jax.lax.stop_gradient(ref_logits)
+
+            logprobs = logprobs_of_labels(logits[:, :-1, :], all_tokens[:, 1:])
+            ref_logprobs = logprobs_of_labels(ref_logits[:, :-1, :], all_tokens[:, 1:])
+            log_ratio = (logprobs - ref_logprobs) * attention_mask[:, :-1]
+            kl = jnp.exp(log_ratio) - 1 - log_ratio
+            # order matches PPOTrainer's score fn: (..., mean per-sequence
+            # KL, mean per-token KL) — the KL controller consumes the first
+            return logprobs, values[:, :-1], log_ratio, kl.sum(1).mean(), kl.mean()
+
+        self._score_fn = jax.jit(score)
+
+    def create_train_dataloader(self, seed_offset: int = 0):
+        # PPO's static-pad-width loader, with the pipelined drop_last
+        # (GPipe cannot replicate a ragged tail batch)
+        return PPOTrainer.create_train_dataloader(self, seed_offset, drop_last=True)

@@ -83,9 +83,7 @@ class PPOTrainer(TPUTrainer):
         # Frozen reference branch (hydra): a copy of the top-of-model params
         # at init (full copy when everything is trainable) — reference
         # AutoModelForCausalLMWithHydraValueHead (modeling_ppo.py:385-499).
-        ref = ref_param_subtree(self.params, self.model_cfg, self.split)
-        ref_shardings = infer_param_shardings(self.runtime.mesh, ref)
-        self.ref_params = jax.tree_util.tree_map(jax.device_put, ref, ref_shardings)
+        self.ref_params = self._build_ref_params()
 
         if config.method.target is not None:
             self.kl_ctl = AdaptiveKLController(
@@ -104,6 +102,13 @@ class PPOTrainer(TPUTrainer):
             self.setup_rollout_logging(config)
 
         self._score_fn = None
+
+    def _build_ref_params(self):
+        """Extract + place the frozen reference subtree (overridden by the
+        pipelined trainer, whose reference lives stacked on the pipe axis)."""
+        ref = ref_param_subtree(self.params, self.model_cfg, self.split)
+        ref_shardings = infer_param_shardings(self.runtime.mesh, ref)
+        return jax.tree_util.tree_map(jax.device_put, ref, ref_shardings)
 
     def get_arch(self, config: TRLConfig):
         return build_model(
@@ -487,7 +492,7 @@ class PPOTrainer(TPUTrainer):
     def post_backward_callback(self):
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
 
-    def create_train_dataloader(self, seed_offset: int = 0):
+    def create_train_dataloader(self, seed_offset: int = 0, drop_last: bool = False):
         # seed moves with iter_count so each inner epoch reshuffles (the
         # reference's torch DataLoader draws from global RNG each epoch);
         # seed_offset distinguishes epochs created up front by the fused path.
@@ -499,7 +504,7 @@ class PPOTrainer(TPUTrainer):
         exp_max_new = int(exp_kwargs.get("max_new_tokens", 40))
         eval_max_new = int(self.generate_kwargs.get("max_new_tokens", 40))
         return self.store.create_loader(
-            self.config.train.batch_size, shuffle=True,
+            self.config.train.batch_size, shuffle=True, drop_last=drop_last,
             seed=self.config.train.seed + self.iter_count + seed_offset,
             max_query_len=self.config.train.seq_length - eval_max_new,
             max_response_len=exp_max_new + (1 if self.seq2seq else 0),

@@ -1,0 +1,40 @@
+"""PPO sentiments on a mixture-of-experts policy (beyond the reference —
+expert-parallel RLHF: experts shard over the `tensor` mesh axis, the
+Switch-style load-balancing loss rides the PPO objective)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import trlx_tpu as trlx
+from examples.sentiments import PROMPTS, metric_fn, reward_fn
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_ppo_config
+
+default_config = default_ppo_config().evolve(
+    model=dict(model_path="random:moe-tiny"),
+    tokenizer=dict(tokenizer_path="byte"),
+    train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
+               checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_sentiments_moe"),
+    method=dict(num_rollouts=64, chunk_size=32,
+                gen_kwargs=dict(max_new_tokens=24, top_k=0, top_p=1.0, do_sample=True)),
+)
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config, hparams)
+    return trlx.train(
+        reward_fn=reward_fn,
+        prompts=PROMPTS * 8,
+        eval_prompts=PROMPTS,
+        metric_fn=metric_fn,
+        config=config,
+    )
+
+
+if __name__ == "__main__":
+    import json
+
+    hparams = {} if len(sys.argv) == 1 else json.loads(sys.argv[1])
+    main(hparams)

@@ -37,6 +37,7 @@ EXAMPLES = [
     ("examples.sentiments.ppo_sentiments_peft", TINY_PPO),
     ("examples.sentiments.ppo_sentiments_t5", TINY_PPO),
     ("examples.sentiments.ppo_sentiments_llama", TINY_PPO),
+    ("examples.sentiments.ppo_sentiments_moe", TINY_PPO),
     ("examples.sentiments.ilql_sentiments", TINY),
     ("examples.sentiments.ilql_sentiments_t5", TINY),
     ("examples.sentiments.sft_sentiments", TINY),

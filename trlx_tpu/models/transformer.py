@@ -701,6 +701,11 @@ PRESETS: Dict[str, Dict[str, Any]] = {
     "bigcode-tiny": dict(
         d_model=64, n_layers=2, n_heads=4, n_kv_heads=1, d_ff=256, max_seq_len=256,
     ),
+    # Mixture-of-experts (beyond the reference): experts shard over `tensor`
+    "moe-tiny": dict(
+        d_model=64, n_layers=2, n_heads=4, d_ff=256, max_seq_len=256,
+        moe_experts=4, moe_top_k=2,
+    ),
 }
 
 

@@ -131,8 +131,16 @@ class PipelinedCausalMixin:
         # drop_last: the GPipe shard_map needs every batch divisible by
         # data x n_microbatches — a ragged tail batch can't be replicated
         # the way the GSPMD trainers fall back to
+        batch_size = self.config.train.batch_size
+        n = len(self.store)
+        if n < batch_size:
+            logger.warning(
+                f"Pipelined trainer store holds {n} samples < batch_size "
+                f"{batch_size}; with drop_last the epoch runs ZERO optimizer "
+                "steps — lower train.batch_size or provide more data"
+            )
         return self.store.create_loader(
-            self.config.train.batch_size, shuffle=True, drop_last=True,
+            batch_size, shuffle=True, drop_last=True,
             seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 

@@ -1,0 +1,46 @@
+"""Pipeline-parallel RFT trainer: rejection-sampling fine-tuning with the
+CE loss running through the stacked GPipe program (the reference has no
+PP path for RFT at all — this completes pipeline coverage of every
+method in the trainer family). Generation-heavy improve steps sample on
+the per-step-cached unstacked view (see PipelinedCausalMixin)."""
+
+from typing import Callable, Optional
+
+import jax
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import merge_params
+from trlx_tpu.trainer.pipelined_mixin import PipelinedCausalMixin
+from trlx_tpu.trainer.rft_trainer import RFTTrainer
+from trlx_tpu.trainer.sft_trainer import causal_lm_ce_loss
+
+
+@register_trainer
+class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
+    def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
+        self._validate_pipeline_config(config)
+        self._n_microbatches = n_microbatches
+        super().__init__(config, **kwargs)
+
+    def make_trainable_mask(self, params):
+        mask = PipelinedCausalMixin.make_trainable_mask(self, params)
+        if "v_head" in mask:
+            mask["v_head"] = jax.tree_util.tree_map(lambda _: False, mask["v_head"])
+        return mask
+
+    def make_loss_fn(self) -> Callable:
+        fwd = self.make_stacked_lm_forward()
+
+        def loss_fn(train_params, frozen_params, batch):
+            # CE over all real tokens, prompt included (reference
+            # accelerate_rft_trainer.py:83-88 uses labels=input_ids) —
+            # causal_lm_ce_loss with labels=None is exactly that math,
+            # shared so the losses cannot drift
+            params = merge_params(train_params, frozen_params)
+            input_ids = batch["input_ids"]
+            attention_mask = batch["attention_mask"]
+            logits = fwd(params["lm_stacked"], params["lm_rest"], input_ids, attention_mask)
+            return causal_lm_ce_loss(logits, input_ids, attention_mask)
+
+        return loss_fn

@@ -23,6 +23,7 @@ for _mod in (
     "trlx_tpu.trainer.pipelined_sft_trainer",
     "trlx_tpu.trainer.pipelined_ilql_trainer",
     "trlx_tpu.trainer.pipelined_ppo_trainer",
+    "trlx_tpu.trainer.pipelined_rft_trainer",
 ):
     try:
         __import__(_mod)

@@ -1,0 +1,117 @@
+"""Mesh construction: axis resolution, DP/FSDP/TP layouts, multi-slice
+(DCN) hybrid meshes, and the multi-host init helper (reference: Accelerate
+launcher + torch.distributed process groups, SURVEY.md §5.8 — untested
+there; here deterministic on the virtual 8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.configs import ParallelConfig
+from trlx_tpu.parallel import MeshRuntime, initialize_distributed, make_mesh
+
+
+def test_make_mesh_resolves_wildcard_axis():
+    mesh = make_mesh(data=-1, fsdp=2, tensor=2)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "fsdp": 2, "tensor": 2, "sequence": 1,
+    }
+
+
+def test_make_mesh_rejects_bad_sizes():
+    with pytest.raises(ValueError):
+        make_mesh(data=3, fsdp=3)  # 9 != 8 devices
+    with pytest.raises(ValueError):
+        make_mesh(data=-1, fsdp=-1)  # two wildcards
+
+
+def test_hybrid_dcn_mesh_shape_and_collectives():
+    """dcn_data folds into the data axis; on CPU (no slice topology) the
+    fallback reshape still yields the right global shape, and a psum over
+    the full data axis spans all slices."""
+    mesh = make_mesh(data=4, fsdp=2, dcn_data=2)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "fsdp": 2, "tensor": 1, "sequence": 1,
+    }
+    # every device appears exactly once
+    ids = sorted(d.id for d in mesh.devices.flat)
+    assert ids == sorted(d.id for d in jax.devices())
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(
+        np.arange(8, dtype=np.float32), NamedSharding(mesh, P(("data", "fsdp")))
+    )
+    total = jax.jit(
+        lambda x: jnp.sum(x), out_shardings=NamedSharding(mesh, P())
+    )(x)
+    assert float(total) == 28.0
+
+
+class _StubDevice:
+    """Minimal device stand-in carrying slice topology, enough for
+    mesh_utils.create_hybrid_device_mesh's attribute sorting."""
+
+    def __init__(self, id, slice_index, process_index):
+        self.id = id
+        self.slice_index = slice_index
+        self.process_index = process_index
+        self.platform = "tpu"
+        self.device_kind = "stub"
+        # 2x2 physical chip grid within each slice
+        self.coords = (id % 2, (id % 4) // 2, 0)
+        self.core_on_chip = 0
+
+    def __repr__(self):
+        return f"StubDevice(id={self.id}, slice={self.slice_index})"
+
+
+def test_hybrid_branch_keeps_inner_axes_within_slice():
+    """The real create_hybrid_device_mesh path (not the CPU fallback): with
+    2 slices x 4 chips, the fsdp axis must stay inside a slice and the data
+    axis must be slice-major, so only data-parallel traffic crosses DCN."""
+    devices = [_StubDevice(id=i, slice_index=i // 4, process_index=i // 4) for i in range(8)]
+    mesh = make_mesh(data=4, fsdp=2, dcn_data=2, devices=devices)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 4, "fsdp": 2, "tensor": 1, "sequence": 1,
+    }
+    for d in range(4):
+        row = mesh.devices[d].flat
+        slices = {dev.slice_index for dev in row}
+        assert len(slices) == 1, f"fsdp axis spans slices at data={d}: {slices}"
+    # data axis is slice-major: first half slice 0, second half slice 1
+    data_slices = [mesh.devices[d, 0, 0, 0].slice_index for d in range(4)]
+    assert data_slices == sorted(data_slices)
+    assert sorted(dev.id for dev in mesh.devices.flat) == list(range(8))
+
+
+def test_hybrid_dcn_mesh_divisibility_error():
+    with pytest.raises(ValueError):
+        make_mesh(data=4, fsdp=2, dcn_data=3)
+    with pytest.raises(ValueError):
+        make_mesh(data=-1, dcn_data=-1)  # no wildcard for the slice count
+    with pytest.raises(ValueError):
+        make_mesh(data=-1, dcn_data=0)
+
+
+def test_mesh_runtime_from_config_with_dcn():
+    runtime = MeshRuntime.from_config(
+        ParallelConfig(data=4, fsdp=2, dcn_data=2)
+    )
+    assert runtime.dp_size == 8
+    assert runtime.n_devices == 8
+
+
+def test_initialize_distributed_noop_single_process(monkeypatch):
+    # No coordinator configured -> returns without touching the backend.
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS", raising=False)
+    initialize_distributed()
+    initialize_distributed(num_processes=1)
+    # a bare process_id with no coordinator is a misconfiguration, not a no-op
+    with pytest.raises(ValueError):
+        initialize_distributed(process_id=3)
+    assert jax.process_count() == 1

@@ -136,6 +136,9 @@ class ParallelConfig:
     tensor: int = 1
     sequence: int = 1
     pipeline: int = 1
+    # multi-slice scale-out: number of DCN-connected slices, folded into the
+    # data axis so only data-parallel gradient reductions cross DCN
+    dcn_data: int = 1
     remat: bool = False
     scan_layers: bool = False
     param_dtype: str = "float32"

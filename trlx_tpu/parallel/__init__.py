@@ -11,6 +11,7 @@ over ICI/DCN.
 from trlx_tpu.parallel.mesh import (  # noqa: F401
     MESH_AXES,
     MeshRuntime,
+    initialize_distributed,
     make_mesh,
 )
 from trlx_tpu.parallel.sharding import (  # noqa: F401

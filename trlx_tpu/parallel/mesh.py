@@ -57,6 +57,7 @@ def make_mesh(
     fsdp: int = 1,
     tensor: int = 1,
     sequence: int = 1,
+    dcn_data: int = 1,
     devices=None,
 ) -> Mesh:
     """Build the global device mesh.
@@ -65,16 +66,117 @@ def make_mesh(
     lays axes out so the innermost (tensor/sequence) axes map to
     nearest-neighbor ICI links, keeping TP all-reduces and ring-attention
     ppermutes off DCN.
+
+    `dcn_data > 1` builds a multi-slice hybrid mesh: `dcn_data` slices are
+    data-parallel over DCN while fsdp/tensor/sequence (and the per-slice
+    share of `data`) stay within each slice's ICI. This is the multi-slice
+    scale-out path the reference reaches through NCCL over IB + slurm
+    (SURVEY.md §5.8); here the slow-network axis folds into the leading
+    "data" axis so only gradient psums cross DCN.
     """
+    if dcn_data < 1:
+        # unlike the ICI axes there is no -1 wildcard here: the slice count
+        # is fixed by the deployment, never inferred
+        raise ValueError(f"dcn_data must be >= 1, got {dcn_data}")
     devices = devices if devices is not None else jax.devices()
     sizes = _resolve_axis_sizes(len(devices), [data, fsdp, tensor, sequence])
-    try:
+    if dcn_data > 1 and sizes[0] % dcn_data != 0:
+        raise ValueError(f"data axis {sizes[0]} not divisible by dcn_data={dcn_data}")
+
+    has_slice_topology = getattr(devices[0], "slice_index", None) is not None
+    if dcn_data > 1 and not has_slice_topology:
+        logger.warning(
+            f"dcn_data={dcn_data} requested but devices expose no slice "
+            "topology (CPU test mesh, or a platform without slice_index): "
+            "falling back to a flat device mesh. On a real multi-slice "
+            "deployment this would put inner mesh axes on the slow network."
+        )
+    if dcn_data > 1 and has_slice_topology:
+        # Real multi-slice topology: let layout errors propagate — a silent
+        # fallback here could put TP/FSDP axes on DCN, defeating the point.
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
-    except Exception:  # CPU/host meshes without topology info
-        dev_array = np.asarray(devices).reshape(sizes)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (sizes[0] // dcn_data,) + tuple(sizes[1:]), (dcn_data, 1, 1, 1),
+            devices=devices,
+        )
+    else:
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+        except Exception:  # CPU/host meshes without topology info
+            dev_array = np.asarray(devices).reshape(sizes)
     return Mesh(dev_array, MESH_AXES)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialize JAX's multi-host runtime (the reference's
+    `torch.distributed.init_process_group` + Accelerate launcher role,
+    SURVEY.md §5.8). On TPU pods `jax.distributed.initialize()` discovers
+    the topology from metadata; args/env (`COORDINATOR_ADDRESS`,
+    `NUM_PROCESSES`, `PROCESS_ID` — the WORLD_SIZE/RANK analogues of
+    §5.6) override for CPU/GPU fleets. No-op when single-process or
+    already initialized."""
+    import os
+
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    # TPU pods carry worker metadata in the environment; there,
+    # jax.distributed.initialize() auto-discovers the topology with no args.
+    # Require >1 worker hostname — single-host setups (including this repo's
+    # axon tunnel) also export TPU_WORKER_HOSTNAMES.
+    on_tpu_pod = (
+        "," in os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    )
+    if coordinator_address is None and num_processes in (None, 1) and not on_tpu_pod:
+        if process_id is not None:
+            raise ValueError(
+                f"process_id={process_id} given without coordinator_address/"
+                "num_processes — refusing to silently run single-process"
+            )
+        return  # single-process: nothing to initialize
+    try:
+        from jax._src.distributed import global_state
+
+        if getattr(global_state, "client", None) is not None:
+            logger.info("jax.distributed already initialized; skipping")
+            return
+    except ImportError:  # private path moved: fall through to error matching
+        pass
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # jax raises "distributed.initialize should only be called once."
+        # on double init (older versions said "already initialized")
+        msg = str(e).lower()
+        if "once" in msg or "already" in msg:
+            logger.info("jax.distributed already initialized; skipping")
+        elif "before any jax" in msg or "computations are executed" in msg:
+            # The backend was touched before bootstrap (e.g. MeshRuntime
+            # built directly without going through trlx_tpu.train). Loud
+            # warning rather than crash: single-host runs are unaffected;
+            # multi-host runs will fail visibly at the first collective.
+            logger.warning(
+                "jax.distributed.initialize() called after the JAX backend "
+                "was already in use — multi-host bootstrap skipped. Call "
+                "trlx_tpu.parallel.initialize_distributed() before any JAX "
+                "computation (trlx_tpu.train does this automatically)."
+            )
+        else:
+            raise
 
 
 @dataclass
@@ -87,14 +189,23 @@ class MeshRuntime:
 
     @classmethod
     def from_config(cls, parallel_config, devices=None) -> "MeshRuntime":
+        # Multi-host bootstrap before the first jax.devices() call: no-op on
+        # single-process setups, auto-discovers TPU pod topology otherwise.
+        if devices is None:
+            initialize_distributed()
         if getattr(parallel_config, "pipeline", 1) not in (1, None):
             # ("data", "pipe") mesh for GPipe trainers; fsdp/tensor compose
             # with PP only through the stacked-param layout those trainers
             # own, so they must stay 1 here.
-            if parallel_config.fsdp != 1 or parallel_config.tensor != 1 or parallel_config.sequence != 1:
+            if (
+                parallel_config.fsdp != 1
+                or parallel_config.tensor != 1
+                or parallel_config.sequence != 1
+                or getattr(parallel_config, "dcn_data", 1) != 1
+            ):
                 raise NotImplementedError(
                     "parallel.pipeline composes with the data axis only "
-                    "(DP x PP); set fsdp/tensor/sequence to 1"
+                    "(DP x PP); set fsdp/tensor/sequence/dcn_data to 1"
                 )
             from trlx_tpu.parallel.pipeline import make_pipe_mesh
 
@@ -121,6 +232,7 @@ class MeshRuntime:
             fsdp=parallel_config.fsdp,
             tensor=parallel_config.tensor,
             sequence=parallel_config.sequence,
+            dcn_data=getattr(parallel_config, "dcn_data", 1),
             devices=devices,
         )
         logger.info(f"Device mesh: {dict(zip(MESH_AXES, mesh.devices.shape))}")

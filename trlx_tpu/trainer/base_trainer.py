@@ -74,6 +74,12 @@ class TPUTrainer(BaseRLTrainer):
             logit_mask=logit_mask,
             stop_sequences=stop_sequences,
         )
+        # Multi-host bootstrap must precede the first backend use (the
+        # PRNGKey below); no-op on single-process setups.
+        if devices is None:
+            from trlx_tpu.parallel import initialize_distributed
+
+            initialize_distributed()
         set_seed(config.train.seed)
         self.rng = jax.random.PRNGKey(config.train.seed)
         self.tokenizer = get_tokenizer(config.tokenizer)

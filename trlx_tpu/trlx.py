@@ -41,6 +41,12 @@ def train(  # noqa: C901
     constrains token transitions during generation (e.g. graph adjacency in
     the randomwalks benchmark).
     """
+    # Multi-host bootstrap must precede any JAX computation (set_seed below
+    # touches the backend); no-op on single-process setups.
+    from trlx_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
+
     if config is None:
         warnings.warn(
             "Passing the `config` argument implicitly is deprecated, adapt one "

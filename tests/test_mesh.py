@@ -95,6 +95,11 @@ def test_hybrid_dcn_mesh_divisibility_error():
         make_mesh(data=-1, dcn_data=0)
 
 
+def test_pipeline_interleave_requires_pipeline():
+    with pytest.raises(ValueError):
+        MeshRuntime.from_config(ParallelConfig(data=8, pipeline=1, pipeline_interleave=2))
+
+
 def test_mesh_runtime_from_config_with_dcn():
     runtime = MeshRuntime.from_config(
         ParallelConfig(data=4, fsdp=2, dcn_data=2)

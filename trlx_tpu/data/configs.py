@@ -136,6 +136,10 @@ class ParallelConfig:
     tensor: int = 1
     sequence: int = 1
     pipeline: int = 1
+    # virtual stages per pipeline device (interleaved schedule; >1 shrinks
+    # the pipeline bubble by ~1/pipeline_interleave at the cost of more
+    # ring hops — megatron's virtual PP)
+    pipeline_interleave: int = 1
     # multi-slice scale-out: number of DCN-connected slices, folded into the
     # data axis so only data-parallel gradient reductions cross DCN
     dcn_data: int = 1

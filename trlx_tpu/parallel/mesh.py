@@ -227,6 +227,11 @@ class MeshRuntime:
                 f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
             )
             return PipeMeshRuntime(mesh=mesh)
+        if getattr(parallel_config, "pipeline_interleave", 1) not in (1, None):
+            raise ValueError(
+                "parallel.pipeline_interleave requires parallel.pipeline > 1 "
+                "(virtual stages interleave an existing pipeline)"
+            )
         mesh = make_mesh(
             data=parallel_config.data,
             fsdp=parallel_config.fsdp,

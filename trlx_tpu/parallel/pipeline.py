@@ -179,18 +179,147 @@ def gpipe_blocks(
     return out.reshape(B, t, d)
 
 
+def stack_block_params_interleaved(
+    params: Dict, n_layers: int, n_stages: int, n_virtual: int
+) -> Tuple[Dict, Dict]:
+    """Round-robin (virtual-stage) chunk layout: [n_stages, n_virtual, lps,
+    ...] where device `idx` holds chunks `l*n_stages + idx` for loop l —
+    the interleaved-1F1B placement of Megatron's virtual pipeline
+    (reference: virtual-PP bucket config, modeling_nemo_ppo.py:573-585).
+    With n_virtual == 1 this is exactly stack_block_params (the GPipe
+    layout), so call sites need no dispatch."""
+    if n_virtual == 1:
+        return stack_block_params(params, n_layers, n_stages)
+    if n_layers % (n_stages * n_virtual) != 0:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pipeline={n_stages} x "
+            f"pipeline_interleave={n_virtual}"
+        )
+    stacked, rest = stack_block_params(params, n_layers, n_stages * n_virtual)
+    stacked = jax.tree_util.tree_map(
+        lambda x: x.reshape(n_virtual, n_stages, *x.shape[1:]).swapaxes(0, 1),
+        stacked,
+    )
+    return stacked, rest
+
+
+def unstack_block_params_interleaved(
+    stacked: Dict, rest: Dict, n_layers: int, n_virtual: int
+) -> Dict:
+    """Inverse of stack_block_params_interleaved; with n_virtual == 1 this
+    is exactly unstack_block_params, so call sites need no dispatch."""
+    if n_virtual == 1:
+        return unstack_block_params(stacked, rest, n_layers)
+    flat = jax.tree_util.tree_map(
+        lambda x: x.swapaxes(0, 1).reshape(-1, *x.shape[2:]), stacked
+    )
+    return unstack_block_params(flat, rest, n_layers)
+
+
+def interleaved_blocks(
+    cfg: TransformerConfig,
+    stage_params,  # local [1, v, lps, ...] pytree (sharded over pipe axis)
+    h: jnp.ndarray,  # [B, t, d] full batch (replicated across pipe axis)
+    attn_mask: jnp.ndarray,  # [B, t]
+    n_microbatches: int,
+    n_virtual: int,
+    axis_name: str = PIPE_AXIS,
+) -> jnp.ndarray:
+    """Interleaved (virtual-stage) pipeline schedule: each device holds
+    `n_virtual` layer chunks placed round-robin, and every microbatch loops
+    the device ring `n_virtual` times. The pipeline bubble shrinks from
+    (S-1)/M of GPipe to ~(S-1)/(M·v): the fill/drain ramp now costs
+    thin chunks instead of a device's whole layer stack.
+
+    Microbatch m enters stage 0 at tick `t_m = (m mod S) + (m div S)·S·v` —
+    within a wave of S microbatches entries are back-to-back, and waves are
+    spaced S·v apart so a device never hosts two microbatches on the same
+    tick (m and m' collide iff t_m ≡ t_m' (mod S) with |t_m − t_m'| < S·v;
+    the spacing rules both out). At tick r, device `idx` serves microbatch
+    `m = base + w·S` on loop `l = q // S`, where `base = (r − idx) mod S`,
+    `w = (r − base) div (S·v)`, `q = r − t_m`; chunk l covers global layers
+    `(l·S + idx)·lps .. +lps`. The ring ppermute wraps around (S−1 → 0) so
+    loop l's output on the last device feeds loop l+1 on the first; like
+    the GPipe path, bubbles are predicated out with `where` and backward is
+    pure autodiff through the transposed ppermute."""
+    S = jax.lax.psum(1, axis_name)  # static: psum of a literal
+    idx = jax.lax.axis_index(axis_name)
+    v = n_virtual
+    my_chunks = jax.tree_util.tree_map(lambda x: x[0], stage_params)  # [v, lps, ...]
+
+    B, t, d = h.shape
+    M = n_microbatches
+    assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
+    mb = B // M
+    h_mbs = h.reshape(M, mb, t, d)
+    mask_mbs = attn_mask.reshape(M, mb, t)
+
+    def stage(chunk_params, x, mask):
+        positions = position_ids(mask)
+        bias = train_bias(cfg, mask)
+        return _apply_layer_stack(cfg, chunk_params, x, bias, positions, mask)
+
+    ring_perm = [(s, (s + 1) % S) for s in range(S)]
+    span = S * v
+    t_last = ((M - 1) % S) + ((M - 1) // S) * span
+    n_ticks = t_last + span
+
+    def tick(carry, r):
+        recv_h, recv_mask, out = carry
+        base = (r - idx) % S
+        w = (r - base) // span
+        q = r - base - w * span  # ticks since this mb entered stage 0
+        m = base + w * S
+        loop = q // S
+        valid = (w >= 0) & (m < M)
+
+        m_in = jnp.clip(m, 0, M - 1)
+        mb_h = jax.lax.dynamic_index_in_dim(h_mbs, m_in, 0, keepdims=False)
+        mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, m_in, 0, keepdims=False)
+        ingest = (idx == 0) & (loop == 0) & valid
+        x = jnp.where(ingest, mb_h, recv_h)
+        mask = jnp.where(ingest, mb_mask, recv_mask)
+
+        loop_in = jnp.clip(loop, 0, v - 1)
+        chunk = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, loop_in, 0, keepdims=False),
+            my_chunks,
+        )
+        y = stage(chunk, x, mask)
+
+        bank_now = valid & (idx == S - 1) & (loop == v - 1)
+        banked = jax.lax.dynamic_update_index_in_dim(out, y, m_in, 0)
+        out = jnp.where(bank_now, banked, out)
+
+        next_h, next_mask = jax.lax.ppermute((y, mask), axis_name, ring_perm)
+        return (next_h, next_mask, out), None
+
+    out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
+    init = jax.tree_util.tree_map(
+        lambda x: _varying(x, axis_name),
+        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]), out0),
+    )
+    (_, _, out), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+
+    out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
+    return out.reshape(B, t, d)
+
+
 def make_gpipe_forward_stacked(
     model,  # TransformerLM (or a module exposing embed/unembed + blocks)
     cfg: TransformerConfig,
     mesh: Mesh,
     n_microbatches: int,
     with_hidden: bool = False,
+    n_virtual: int = 1,
 ) -> Callable:
     """Build fn(stacked, rest, tokens, attn_mask) -> logits (or
     (logits, h_final) with with_hidden) where `stacked` is the
     [n_stages, lps, ...] block pytree living sharded over the "pipe" axis
     — the layout the pipelined trainer keeps params in permanently, so no
-    per-call restacking."""
+    per-call restacking. With n_virtual > 1 `stacked` is the interleaved
+    [n_stages, n_virtual, lps, ...] layout and the interleaved schedule
+    runs instead of GPipe."""
 
     def embed(rest_params, tokens, attn_mask):
         positions = position_ids(attn_mask)
@@ -201,7 +330,10 @@ def make_gpipe_forward_stacked(
 
     def inner(stacked, rest, tokens, attn_mask):
         h = embed(rest, tokens, attn_mask)
-        h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
+        if n_virtual > 1:
+            h = interleaved_blocks(cfg, stacked, h, attn_mask, n_microbatches, n_virtual)
+        else:
+            h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
         logits, h_final = unembed(rest, h)
         return (logits, h_final) if with_hidden else logits
 
@@ -224,17 +356,23 @@ def make_gpipe_forward(
     mesh: Mesh,
     n_stages: int,
     n_microbatches: int,
+    n_virtual: int = 1,
 ) -> Callable:
     """Build fn(params, tokens, attn_mask) -> logits running the block
-    stack as a GPipe pipeline over `mesh`'s "pipe" axis. Params are taken
-    in standard (unstacked) TransformerLM layout; stacking happens inside
-    the jitted fn so the same checkpoint format serves every layout (the
-    reference instead reshards checkpoints per PP stage,
+    stack as a GPipe (or, with n_virtual > 1, interleaved virtual-stage)
+    pipeline over `mesh`'s "pipe" axis. Params are taken in standard
+    (unstacked) TransformerLM layout; stacking happens inside the jitted
+    fn so the same checkpoint format serves every layout (the reference
+    instead reshards checkpoints per PP stage,
     modeling_nemo_ppo.py:321-352)."""
-    stacked_fwd = make_gpipe_forward_stacked(model, cfg, mesh, n_microbatches)
+    stacked_fwd = make_gpipe_forward_stacked(
+        model, cfg, mesh, n_microbatches, n_virtual=n_virtual
+    )
 
     def fwd(params, tokens, attn_mask):
-        stacked, rest = stack_block_params(params, cfg.n_layers, n_stages)
+        stacked, rest = stack_block_params_interleaved(
+            params, cfg.n_layers, n_stages, n_virtual
+        )
         return stacked_fwd(stacked, rest, tokens, attn_mask)
 
     return fwd

@@ -23,8 +23,8 @@ from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.parallel.mesh import PipeMeshRuntime
 from trlx_tpu.parallel.pipeline import (
     make_gpipe_forward_stacked,
-    stack_block_params,
-    unstack_block_params,
+    stack_block_params_interleaved,
+    unstack_block_params_interleaved,
 )
 from trlx_tpu.trainer.base_trainer import merge_params
 from trlx_tpu.utils import logging
@@ -36,6 +36,11 @@ class PipelinedCausalMixin:
     def _validate_pipeline_config(self, config: TRLConfig):
         if getattr(config.parallel, "pipeline", 1) <= 1:
             raise ValueError(f"{type(self).__name__} requires parallel.pipeline > 1")
+        self._n_virtual = int(getattr(config.parallel, "pipeline_interleave", 1) or 1)
+        if self._n_virtual < 1:
+            raise ValueError(
+                f"parallel.pipeline_interleave must be >= 1, got {self._n_virtual}"
+            )
         if config.model.model_arch_type != "causal":
             raise NotImplementedError("pipeline parallelism covers causal models")
         if config.model.num_layers_unfrozen != -1:
@@ -65,7 +70,9 @@ class PipelinedCausalMixin:
         cfg = self.model_cfg
         if getattr(self, "_n_microbatches", None) is None:
             self._n_microbatches = n_stages
-        stacked, rest = stack_block_params(params["lm"], cfg.n_layers, n_stages)
+        stacked, rest = stack_block_params_interleaved(
+            params["lm"], cfg.n_layers, n_stages, self._n_virtual
+        )
         placed = {
             "lm_stacked": jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, runtime.pipe_sharding), stacked
@@ -102,6 +109,7 @@ class PipelinedCausalMixin:
         return make_gpipe_forward_stacked(
             TransformerLM(self.model_cfg), self.model_cfg, self.runtime.mesh,
             n_microbatches=self._n_microbatches, with_hidden=with_hidden,
+            n_virtual=self._n_virtual,
         )
 
     def standard_params(self) -> Dict:
@@ -113,8 +121,9 @@ class PipelinedCausalMixin:
         if cached is not None and cached[0] == self.iter_count:
             return cached[1]
         params = merge_params(self.train_params, self.frozen_params)
-        lm = unstack_block_params(
-            params["lm_stacked"], params["lm_rest"], self.model_cfg.n_layers
+        lm = unstack_block_params_interleaved(
+            params["lm_stacked"], params["lm_rest"], self.model_cfg.n_layers,
+            self._n_virtual,
         )
         out = {"lm": lm}
         for k, v in params.items():

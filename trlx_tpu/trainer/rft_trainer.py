@@ -21,6 +21,7 @@ from trlx_tpu.models.transformer import position_ids
 from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
+from trlx_tpu.utils.modeling import logprobs_of_labels
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -70,11 +71,10 @@ class RFTTrainer(TPUTrainer):
             logits, _, _ = model.apply(
                 {"params": params}, input_ids, attention_mask, position_ids(attention_mask)
             )
-            shift_logits = logits[:, :-1, :].astype(jnp.float32)
+            shift_logits = logits[:, :-1, :]
             labels = input_ids[:, 1:]
             valid = attention_mask[:, 1:] > 0
-            logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
-            nll = -jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+            nll = -logprobs_of_labels(shift_logits, labels)
             n = jnp.maximum(valid.sum(), 1)
             loss = jnp.where(valid, nll, 0.0).sum() / n
             return loss, {"loss": loss}

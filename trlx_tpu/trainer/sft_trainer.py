@@ -18,6 +18,7 @@ from trlx_tpu.models.transformer import position_ids
 from trlx_tpu.pipeline.offline_pipeline import DialogStore, PromptPipeline, tokenize_dialogue
 from trlx_tpu.trainer import register_trainer
 from trlx_tpu.trainer.base_trainer import TPUTrainer, merge_params
+from trlx_tpu.utils.modeling import logprobs_of_labels
 
 
 @dataclass
@@ -35,12 +36,11 @@ def causal_lm_ce_loss(logits, input_ids, attention_mask, labels=None):
     ignore_index = DialogStore.IGNORE_INDEX
     if labels is None:
         labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
-    shift_logits = logits[:, :-1, :].astype(jnp.float32)
+    shift_logits = logits[:, :-1, :]
     shift_labels = labels[:, 1:]
     valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
-    logprobs = jax.nn.log_softmax(shift_logits, axis=-1)
     safe_labels = jnp.where(valid, shift_labels, 0)
-    nll = -jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+    nll = -logprobs_of_labels(shift_logits, safe_labels)
     n = jnp.maximum(valid.sum(), 1)
     loss = jnp.where(valid, nll, 0.0).sum() / n
     return loss, {"loss": loss}

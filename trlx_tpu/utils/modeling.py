@@ -65,10 +65,13 @@ def whiten(
 
 def logprobs_of_labels(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     """Log-probabilities of `labels` under `logits` (reference
-    utils/modeling.py:1??: log_softmax + gather). logits: [..., V],
-    labels: [...] int. Computed in float32 for stability."""
-    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+    utils/modeling.py: log_softmax + gather). logits: [..., V], labels:
+    [...] int. Computed in float32 for stability, via the fused op
+    (Pallas streaming kernel on single-chip TPU, gather-minus-logsumexp
+    XLA elsewhere — no [.., V] log_softmax intermediate either way)."""
+    from trlx_tpu.ops.fused_ce import fused_logprobs_of_labels
+
+    return fused_logprobs_of_labels(logits, labels)
 
 
 def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:

@@ -331,3 +331,19 @@ def test_fuse_all_inner_epochs_matches_per_epoch(tmp_path):
             np.asarray(t_per.train_params[k]), np.asarray(t_all.train_params[k]),
             atol=1e-5, err_msg=str(k),
         )
+
+
+def test_ppo_value_branch_full_loop(tmp_path):
+    """num_value_layers_unfrozen > 0 through the full PPO loop (reference
+    make_value_branch, modeling_ppo.py:255-263): the deeper value branch
+    trains end-to-end."""
+    config = ppo_config(tmp_path, total_steps=2)
+    config.method.num_value_layers_unfrozen = 1
+    trainer = trlx.train(
+        reward_fn=count_letters_reward,
+        prompts=["ab", "cd", "ef", "gh"] * 2,
+        eval_prompts=["ab", "cd"] * 4,
+        config=config,
+    )
+    assert trainer.iter_count == 2
+    assert any("value_branch" in str(k) for k in trainer.train_params)

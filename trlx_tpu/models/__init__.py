@@ -107,12 +107,23 @@ def build_model(
     with_ilql_heads: bool = False,
     two_qs: bool = True,
     seq_len: int = 32,
+    num_value_layers: int = 0,
 ) -> Tuple[Any, Any, Dict]:
-    """Returns (flax module, model config, initialized params)."""
+    """Returns (flax module, model config, initialized params).
+
+    `num_value_layers > 0` builds the deeper value branch (reference
+    num_value_layers_unfrozen / make_value_branch, modeling_ppo.py:255-263):
+    a trainable clone of the top-k blocks + final norm feeding the scalar
+    head, initialized from the (loaded) trunk weights."""
     cfg = resolve_transformer_config(model_config, vocab_size)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     if is_seq2seq_config(cfg):
+        if num_value_layers > 0:
+            raise NotImplementedError(
+                "num_value_layers_unfrozen > 0 is causal-only (as in the "
+                "reference, whose make_value_branch targets causal branches)"
+            )
         if with_ilql_heads:
             model = Seq2SeqLMWithILQLHeads(cfg, two_qs=two_qs)
         else:
@@ -123,9 +134,11 @@ def build_model(
         params = model.init(rng, tokens, mask, tokens, mask)["params"]
     else:
         if with_ilql_heads:
+            if num_value_layers > 0:
+                raise NotImplementedError("the value branch is a PPO-value-head feature")
             model = CausalLMWithILQLHeads(cfg, two_qs=two_qs)
         else:
-            model = CausalLMWithValueHead(cfg)
+            model = CausalLMWithValueHead(cfg, num_value_layers=num_value_layers)
         tokens = jnp.zeros((1, min(seq_len, cfg.max_seq_len)), dtype=jnp.int32)
         mask = jnp.ones_like(tokens)
         params = model.init(rng, tokens, mask)["params"]
@@ -150,4 +163,14 @@ def build_model(
         params = hf_interop.load_params_from_hf(
             model_config.model_path, cfg, params
         )
+    if num_value_layers > 0:
+        # Branch weights start as clones of the (loaded) top trunk blocks +
+        # final norm, mirroring the reference's module deepcopy
+        # (modeling_ppo.py:527-533); the scalar head keeps its fresh init.
+        vb = dict(params["value_branch"])
+        for i in range(num_value_layers):
+            src = params["lm"][f"block_{cfg.n_layers - num_value_layers + i}"]
+            vb[f"block_{i}"] = jax.tree_util.tree_map(jnp.copy, src)
+        vb["ln_f"] = jax.tree_util.tree_map(jnp.copy, params["lm"]["ln_f"])
+        params = {**params, "value_branch": vb}
     return model, cfg, params

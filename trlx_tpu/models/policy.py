@@ -23,19 +23,70 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.models.heads import ILQLHeads, MLPHead
-from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.models.transformer import (
+    Block,
+    TransformerConfig,
+    TransformerLM,
+    make_norm,
+    train_bias,
+)
+
+
+class ValueBranch(nn.Module):
+    """Deeper value head: a trainable clone of the top `n_branch_layers`
+    decoder blocks (+ final norm) ending in the scalar MLP head — the
+    reference's make_value_branch / ModelBranch-with-value-lm_head
+    (modeling_ppo.py:255-263). Fed the trunk activation entering block
+    `n_layers - n_branch_layers`; weights start as copies of those trunk
+    blocks (build_model clones them after init/load)."""
+
+    cfg: TransformerConfig
+    n_branch_layers: int
+
+    def setup(self):
+        self.blocks = [Block(self.cfg, name=f"block_{i}") for i in range(self.n_branch_layers)]
+        self.ln_f = make_norm(self.cfg, "ln_f")
+        self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
+
+    def __call__(self, h, attn_mask, positions):
+        bias = train_bias(self.cfg, attn_mask)
+        for blk in self.blocks:
+            h, _ = blk(h, bias, positions, attn_mask=attn_mask)
+        h = self.ln_f(h)
+        return self.v_head(h)[..., 0]
 
 
 class CausalLMWithValueHead(nn.Module):
     cfg: TransformerConfig
+    # > 0: value = a cloned top-k-block branch instead of an MLP off the
+    # final hidden state (reference num_value_layers_unfrozen,
+    # modeling_ppo.py:117-134)
+    num_value_layers: int = 0
 
     def setup(self):
         self.lm = TransformerLM(self.cfg, name="lm")
-        self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
+        if self.num_value_layers > 0:
+            self.value_branch = ValueBranch(
+                self.cfg, self.num_value_layers, name="value_branch"
+            )
+        else:
+            self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
 
     def __call__(self, tokens, attn_mask, positions=None, split: int = 0):
         """Returns (logits, values, h_split). `split` is the hydra branch
         point (0 = no split; h_split is then the embedding output)."""
+        if self.num_value_layers > 0:
+            value_split = self.cfg.n_layers - self.num_value_layers
+            logits, h_split, _, h_value = self.lm.forward_captures(
+                tokens, attn_mask, positions, split, value_split
+            )
+            if positions is None:
+                # the LM's position rule (ring attention offsets differ
+                # from a plain cumsum) — branch blocks must see the same
+                # rotary phases as the trunk blocks they were cloned from
+                positions = self.lm._default_positions(tokens, attn_mask)
+            values = self.value_branch(h_value, attn_mask, positions)
+            return logits, values, h_split
         logits, h_split, h_final = self.lm(tokens, attn_mask, positions, split)
         values = self.v_head(h_final)[..., 0]
         return logits, values, h_split
@@ -52,6 +103,11 @@ class CausalLMWithValueHead(nn.Module):
     def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False, with_value: bool = False):
         logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
         if with_value:
+            if self.num_value_layers > 0:
+                raise NotImplementedError(
+                    "per-step values during decode are not supported with a "
+                    "value branch (values are computed in the scoring pass)"
+                )
             return logits, self.v_head(h)[..., 0], new_cache
         return logits, None, new_cache
 

@@ -525,15 +525,36 @@ class TransformerLM(nn.Module):
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Training/scoring forward (no cache). Returns (logits, h_split,
         h_final) where h_split is the activation entering block `split`."""
+        logits, h_split, h_final, _ = self.forward_captures(
+            tokens, attn_mask, positions, split
+        )
+        return logits, h_split, h_final
+
+    def forward_captures(
+        self,
+        tokens: jnp.ndarray,
+        attn_mask: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        split: int = 0,
+        value_split: int = 0,
+    ):
+        """Like __call__ but additionally captures the activation entering
+        block `value_split` — the input of the deeper value branch
+        (reference make_value_branch feeds hidden_states[-(k+1)],
+        modeling_ppo.py:255-263, 344-346). Returns (logits, h_split,
+        h_final, h_value)."""
         if positions is None:
             positions = self._default_positions(tokens, attn_mask)
         bias = self._train_bias(attn_mask)
         h = self.embed(tokens, positions)
-        h, _ = self.run_blocks(h, bias, positions, 0, split, attn_mask=attn_mask)
-        h_split = h
-        h, _ = self.run_blocks(h, bias, positions, split, self.cfg.n_layers, attn_mask=attn_mask)
+        caps = {}
+        bounds = sorted({0, split, value_split, self.cfg.n_layers})
+        for s, e in zip(bounds, bounds[1:]):
+            caps[s] = h
+            h, _ = self.run_blocks(h, bias, positions, s, e, attn_mask=attn_mask)
+        caps[self.cfg.n_layers] = h
         logits, h_final = self.unembed(h)
-        return logits, h_split, h_final
+        return logits, caps[split], h_final, caps[value_split]
 
     def forward_from(
         self,

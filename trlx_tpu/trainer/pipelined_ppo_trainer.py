@@ -50,6 +50,11 @@ logger = logging.get_logger(__name__)
 class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         self._validate_pipeline_config(config)
+        if getattr(config.method, "num_value_layers_unfrozen", 0):
+            raise NotImplementedError(
+                "num_value_layers_unfrozen (the deeper value branch) is not "
+                "supported under pipeline parallelism; use the GSPMD PPOTrainer"
+            )
         self._n_microbatches = n_microbatches
         super().__init__(config, **kwargs)
 

@@ -115,6 +115,7 @@ class PPOTrainer(TPUTrainer):
             config.model,
             vocab_size=self.tokenizer.vocab_size,
             rng=jax.random.PRNGKey(config.train.seed),
+            num_value_layers=getattr(config.method, "num_value_layers_unfrozen", 0),
         )
 
     def setup_rollout_logging(self, config):

@@ -226,3 +226,157 @@ def test_ppo_trainer_with_peft(tmp_path):
 
     trainer.save(str(tmp_path / "ckpt"))
     trainer.load(str(tmp_path / "ckpt"))
+
+
+# ---------------------------------------------------------------------------
+# Prompt tuning (peft PROMPT_TUNING — reference prompt-adapter handling,
+# modeling_ppo.py:314-327)
+# ---------------------------------------------------------------------------
+
+PROMPT_CONFIG = {"peft_type": "PROMPT_TUNING", "num_virtual_tokens": 4}
+
+
+def _build_prompt():
+    overrides = lora_overrides_from_peft_config(PROMPT_CONFIG)
+    cfg = config_from_preset("gpt2-tiny", vocab_size=64, dtype=jnp.float32, **overrides)
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 12)), jnp.int32)
+    mask = np.ones((2, 12), np.int32)
+    mask[0, :3] = 0  # left padding
+    mask = jnp.asarray(mask)
+    params = model.init(jax.random.PRNGKey(0), tokens, mask)["params"]
+    return cfg, model, params, tokens, mask
+
+
+def test_prompt_tuning_translation_and_param():
+    assert lora_overrides_from_peft_config(PROMPT_CONFIG) == {"prompt_tokens": 4}
+    cfg, model, params, tokens, mask = _build_prompt()
+    assert params["lm"]["soft_prompt"].shape == (4, cfg.d_model)
+    logits, values, _ = model.apply({"params": params}, tokens, mask)
+    assert logits.shape == (2, 12, 64)  # caller-visible length unchanged
+    assert values.shape == (2, 12)
+
+
+def test_prompt_tuning_only_soft_prompt_trains():
+    cfg, model, params, *_ = _build_prompt()
+    tm = trainable_mask(params, cfg, -1)
+    flat = traverse_util.flatten_dict(tm)
+    for k, v in flat.items():
+        if k[0] != "lm":
+            assert v, k
+        else:
+            assert v == ("soft_prompt" in k), k
+
+
+def test_prompt_tuning_ref_is_prompt_free():
+    """forward_ref_full skips the soft prompt: equals a prompt-free model
+    on the same base weights, and differs from the prompted forward."""
+    cfg, model, params, tokens, mask = _build_prompt()
+    logits, _, _ = model.apply({"params": params}, tokens, mask)
+    ref = ref_param_subtree(params, cfg, resolve_split(cfg, 2))
+    assert resolve_split(cfg, 2) == 0  # prompt forces full-ref mode
+    ref_logits = model.apply(
+        {"params": {"lm": ref}}, tokens, mask,
+        method=CausalLMWithValueHead.forward_ref_full,
+    )
+    assert not np.allclose(np.asarray(logits), np.asarray(ref_logits))
+
+    cfg0 = config_from_preset("gpt2-tiny", vocab_size=64, dtype=jnp.float32)
+    m0 = CausalLMWithValueHead(cfg0)
+    p0 = m0.init(jax.random.PRNGKey(1), tokens, mask)["params"]
+    lm0 = {k: v for k, v in params["lm"].items() if k != "soft_prompt"}
+    l0, _, _ = m0.apply({"params": {**p0, "lm": lm0}}, tokens, mask)
+    np.testing.assert_allclose(np.asarray(ref_logits), np.asarray(l0), atol=1e-5)
+
+
+def test_prompt_tuning_decode_matches_forward():
+    from trlx_tpu.models import init_kv_cache
+
+    cfg, model, params, tokens, mask = _build_prompt()
+    logits, _, _ = model.apply({"params": params}, tokens, mask)
+    cache = init_kv_cache(cfg, 2, 12)  # prompt slots reserved internally
+    dl, _, _ = model.apply(
+        {"params": params}, tokens, cache, mask, True,
+        method=CausalLMWithValueHead.decode_step,
+    )
+    np.testing.assert_allclose(np.asarray(dl[:, -1]), np.asarray(logits[:, -1]), atol=1e-4)
+
+
+def test_ppo_trainer_with_prompt_tuning(tmp_path):
+    """Full PPO cycle under prompt tuning: generation, scoring with a
+    prompt-free reference, and a train step that moves only the soft
+    prompt + heads."""
+    from trlx_tpu.pipeline import MiniBatchIterator
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   peft_config=PROMPT_CONFIG),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, tracker=None,
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(num_rollouts=8, chunk_size=8,
+                    gen_kwargs=dict(max_new_tokens=8, do_sample=True)),
+    )
+    trainer = PPOTrainer(
+        config, reward_fn=lambda samples, prompts, outputs, **kw: [float(len(o)) for o in outputs]
+    )
+    for k in trainer.train_params:
+        assert "soft_prompt" in k or str(k[0]) == "v_head", k
+    trainer.add_prompt_pipeline(
+        PromptPipeline(["abcdefgh"] * 16, max_prompt_length=8, tokenizer=trainer.tokenizer)
+    )
+    trainer.make_experience(8)
+    loader = trainer.create_train_dataloader()
+    before = np.asarray(
+        trainer.train_params[next(k for k in trainer.train_params if "soft_prompt" in k)]
+    ).copy()
+    for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+        stats = trainer.train_minibatch(minibatch)
+        break
+    assert np.isfinite(float(np.asarray(stats["losses"]["total_loss"])))
+    after = np.asarray(
+        trainer.train_params[next(k for k in trainer.train_params if "soft_prompt" in k)]
+    )
+    assert not np.allclose(before, after), "soft prompt did not move"
+
+
+def test_prompt_tuning_learned_pos_budget_guard(tmp_path):
+    """Soft prompt + learned positions: seq_length must leave room in the
+    position table (silent embedding clamp otherwise)."""
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   peft_config=PROMPT_CONFIG),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=256, batch_size=4, tracker=None,  # == max_seq_len
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+    )
+    with pytest.raises(ValueError, match="learned-position table"):
+        PPOTrainer(config, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+
+
+def test_prompt_tuning_export_includes_soft_prompt(tmp_path):
+    """save_pretrained writes the trained soft prompt alongside the base
+    checkpoint (HF layout has no slot for it)."""
+    import os
+
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   peft_config=PROMPT_CONFIG),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, tracker=None,
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+    )
+    trainer = PPOTrainer(config, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+    out = str(tmp_path / "hf")
+    trainer.save_pretrained(out)
+    assert os.path.exists(os.path.join(out, "soft_prompt.npy"))
+    sp = np.load(os.path.join(out, "soft_prompt.npy"))
+    assert sp.shape == (4, trainer.model_cfg.d_model)

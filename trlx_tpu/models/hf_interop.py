@@ -472,16 +472,19 @@ def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict
             )
         return a
 
-    # LoRA adapter leaves exist only in the template (freshly initialized,
-    # not in the HF checkpoint) — split them out, map the base weights,
-    # then re-attach the initialized adapters.
+    # Adapter leaves (LoRA matrices, the prompt-tuning soft prompt) exist
+    # only in the template (freshly initialized, not in the HF checkpoint)
+    # — split them out, map the base weights, then re-attach them.
     from trlx_tpu.models.lora import split_lora
 
     lora_leaves, base_flat = split_lora(params_template["lm"])
+    adapter_leaves = dict(lora_leaves)
+    if ("soft_prompt",) in base_flat:
+        adapter_leaves[("soft_prompt",)] = base_flat.pop(("soft_prompt",))
     base_tpl = traverse_util.unflatten_dict(base_flat)
     mapped = jax.tree_util.tree_map(dt, base_tpl, lm)
     new_lm = traverse_util.unflatten_dict(
-        {**traverse_util.flatten_dict(mapped), **lora_leaves}
+        {**traverse_util.flatten_dict(mapped), **adapter_leaves}
     )
 
     new_params = dict(params_template)

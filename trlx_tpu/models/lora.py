@@ -32,15 +32,23 @@ def lora_overrides_from_peft_config(peft_config: Any) -> Dict[str, Any]:
     if not isinstance(peft_config, dict):
         peft_config = {
             k: getattr(peft_config, k)
-            for k in ("peft_type", "r", "lora_alpha", "target_modules")
+            for k in ("peft_type", "r", "lora_alpha", "target_modules",
+                      "num_virtual_tokens")
             if hasattr(peft_config, k)
         }
     peft_type = peft_config.get("peft_type", "LORA")
     # peft.PeftType is a str-enum whose str() is "PeftType.LORA" — compare
     # the enum value, not its repr
     peft_type = str(getattr(peft_type, "value", peft_type)).upper()
+    if peft_type == "PROMPT_TUNING":
+        # soft-prompt adapter (reference prompt-adapter path,
+        # modeling_ppo.py:324-327): trainable virtual embeddings prepended
+        # to every sequence, base weights frozen
+        return {"prompt_tokens": int(peft_config.get("num_virtual_tokens", 8))}
     if peft_type != "LORA":
-        raise ValueError(f"Unsupported peft_type '{peft_type}' (only LORA)")
+        raise ValueError(
+            f"Unsupported peft_type '{peft_type}' (LORA and PROMPT_TUNING)"
+        )
     overrides: Dict[str, Any] = {"lora_rank": int(peft_config.get("r", 8))}
     if "lora_alpha" in peft_config:
         overrides["lora_alpha"] = float(peft_config["lora_alpha"])

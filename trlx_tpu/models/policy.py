@@ -96,8 +96,11 @@ class CausalLMWithValueHead(nn.Module):
         return self.lm.forward_from(h_split, attn_mask, positions, start_layer)
 
     def forward_ref_full(self, tokens, attn_mask, positions=None):
-        """Full reference forward (used when every layer is trainable)."""
-        logits, _, _ = self.lm(tokens, attn_mask, positions, 0)
+        """Full reference forward (used when every layer is trainable).
+        Skips the soft prompt under prompt tuning — the reference likewise
+        gets ref logits from the base model without the prompt adapter
+        (modeling_ppo.py:324-327)."""
+        logits, _, _ = self.lm(tokens, attn_mask, positions, 0, use_prompt=False)
         return logits
 
     def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False, with_value: bool = False):
@@ -156,6 +159,11 @@ def resolve_split(cfg: TransformerConfig, num_layers_unfrozen: int) -> int:
     trlx_tpu/models/lora.py:zero_lora)."""
     if getattr(cfg, "lora_rank", 0) > 0:
         return 0
+    if getattr(cfg, "prompt_tokens", 0) > 0:
+        # the soft prompt changes every hidden state from layer 0 on, so the
+        # branch-point trick is invalid — ref logits come from a full
+        # prompt-free forward (forward_ref_full with use_prompt=False)
+        return 0
     if num_layers_unfrozen == -1:
         return 0
     if num_layers_unfrozen == 0:
@@ -184,6 +192,10 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
         from trlx_tpu.models.lora import zero_lora
 
         return zero_lora(lm)
+    if getattr(cfg, "prompt_tokens", 0) > 0:
+        # base weights are all frozen under prompt tuning (never donated),
+        # and the ref forward runs with use_prompt=False — alias, no copy
+        return lm
     if split == 0:
         return jax.tree_util.tree_map(jnp.copy, lm)
     subtree = {}
@@ -203,11 +215,16 @@ def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: in
     (-1 all LM params, 0 none, k>0 top-k blocks + final norm)."""
     split = resolve_split(cfg, num_layers_unfrozen)
     lora = getattr(cfg, "lora_rank", 0) > 0
+    prompt = getattr(cfg, "prompt_tokens", 0) > 0
 
     def _mask(path_keys, leaf):
         parts = [getattr(k, "key", str(k)) for k in path_keys]
         if parts[0] != "lm":
             return True  # v_head / ilql_heads / any auxiliary head
+        if prompt:
+            # prompt-tuning peft semantics: only the soft prompt (+ heads
+            # above) trains; every base LM weight is frozen.
+            return any(str(getattr(k, "key", k)) == "soft_prompt" for k in path_keys)
         if lora:
             # peft semantics: only adapters (+ heads above) train; every
             # base LM weight is frozen regardless of num_layers_unfrozen.

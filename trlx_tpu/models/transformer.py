@@ -85,6 +85,11 @@ class TransformerConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q_proj", "v_proj")
+    # Prompt tuning (the reference's peft PROMPT_TUNING path,
+    # modeling_ppo.py:314-327 prompt-adapter handling): > 0 prepends that
+    # many trainable soft-prompt embeddings to every sequence; the base
+    # weights freeze and reference logits use a prompt-free forward.
+    prompt_tokens: int = 0
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
@@ -457,6 +462,16 @@ class TransformerLM(nn.Module):
             )
         if cfg.embed_ln:
             self.ln_embed = make_norm(cfg, "ln_embed")
+        if cfg.prompt_tokens > 0:
+            if cfg.attn_impl == "ring":
+                raise NotImplementedError(
+                    "prompt tuning under ring attention is not supported "
+                    "(the soft prompt would need its own sequence shard)"
+                )
+            self.soft_prompt = self.param(
+                "soft_prompt", nn.initializers.normal(stddev=0.02),
+                (cfg.prompt_tokens, cfg.d_model), cfg.param_dtype,
+            )
         self.blocks = [Block(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
         self.ln_f = make_norm(cfg, "ln_f")
         if not cfg.tie_embeddings:
@@ -522,13 +537,29 @@ class TransformerLM(nn.Module):
         attn_mask: jnp.ndarray,  # [b, t]
         positions: Optional[jnp.ndarray] = None,
         split: int = 0,
+        use_prompt: bool = True,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Training/scoring forward (no cache). Returns (logits, h_split,
-        h_final) where h_split is the activation entering block `split`."""
+        h_final) where h_split is the activation entering block `split`.
+        `use_prompt=False` skips the soft prompt (the adapter-disabled
+        reference forward under prompt tuning)."""
         logits, h_split, h_final, _ = self.forward_captures(
-            tokens, attn_mask, positions, split
+            tokens, attn_mask, positions, split, use_prompt=use_prompt
         )
         return logits, h_split, h_final
+
+    def _embed_soft_prompt(self, b, positions_virt):
+        """Soft-prompt rows as embeddings, with the same positional/LN
+        treatment real token embeddings get."""
+        h = jnp.broadcast_to(
+            self.soft_prompt[None].astype(self.cfg.dtype),
+            (b,) + tuple(self.soft_prompt.shape),
+        )
+        if self.cfg.pos_embed == "learned":
+            h = h + self.embed_pos(positions_virt + self.cfg.pos_offset)
+        if self.cfg.embed_ln:
+            h = self.ln_embed(h)
+        return h
 
     def forward_captures(
         self,
@@ -537,23 +568,45 @@ class TransformerLM(nn.Module):
         positions: Optional[jnp.ndarray] = None,
         split: int = 0,
         value_split: int = 0,
+        use_prompt: bool = True,
     ):
         """Like __call__ but additionally captures the activation entering
         block `value_split` — the input of the deeper value branch
         (reference make_value_branch feeds hidden_states[-(k+1)],
         modeling_ppo.py:255-263, 344-346). Returns (logits, h_split,
-        h_final, h_value)."""
-        if positions is None:
-            positions = self._default_positions(tokens, attn_mask)
+        h_final, h_value). Under prompt tuning (cfg.prompt_tokens > 0 and
+        use_prompt) the soft prompt is prepended internally and sliced back
+        off before the unembedding, so logits/h_final keep the caller's
+        sequence length; the captured h_split/h_value carry the extended
+        length (their consumers force split == 0 under prompt tuning)."""
+        P = self.cfg.prompt_tokens if use_prompt else 0
+        if P > 0:
+            b = tokens.shape[0]
+            attn_mask = jnp.concatenate(
+                [jnp.ones((b, P), attn_mask.dtype), attn_mask], axis=1
+            )
+            if positions is None:
+                positions = position_ids(attn_mask)
+            else:
+                virt = jnp.broadcast_to(jnp.arange(P, dtype=positions.dtype), (b, P))
+                positions = jnp.concatenate([virt, positions + P], axis=1)
+            h = jnp.concatenate(
+                [self._embed_soft_prompt(b, positions[:, :P]),
+                 self.embed(tokens, positions[:, P:])],
+                axis=1,
+            )
+        else:
+            if positions is None:
+                positions = self._default_positions(tokens, attn_mask)
+            h = self.embed(tokens, positions)
         bias = self._train_bias(attn_mask)
-        h = self.embed(tokens, positions)
         caps = {}
         bounds = sorted({0, split, value_split, self.cfg.n_layers})
         for s, e in zip(bounds, bounds[1:]):
             caps[s] = h
             h, _ = self.run_blocks(h, bias, positions, s, e, attn_mask=attn_mask)
         caps[self.cfg.n_layers] = h
-        logits, h_final = self.unembed(h)
+        logits, h_final = self.unembed(h[:, P:] if P > 0 else h)
         return logits, caps[split], h_final, caps[value_split]
 
     def forward_from(
@@ -582,9 +635,17 @@ class TransformerLM(nn.Module):
     ):
         """One cached decode call. The cache pytree carries:
         index (scalar write offset), mask [b, S], pos [b] (next position id
-        per row), layers (per-layer k/v)."""
+        per row), layers (per-layer k/v). Under prompt tuning the prefill
+        prepends the soft prompt into the cache (init_kv_cache reserves the
+        extra slots); logits keep the caller's sequence length."""
         b, t = tokens.shape
         index = cache["index"]
+        P = self.cfg.prompt_tokens if is_prefill else 0
+        if P > 0:
+            token_mask = jnp.concatenate(
+                [jnp.ones((b, P), token_mask.dtype), token_mask], axis=1
+            )
+        t_ext = t + P
         # positions of the incoming tokens
         if is_prefill:
             positions = position_ids(token_mask)
@@ -595,7 +656,7 @@ class TransformerLM(nn.Module):
         new_mask = jax.lax.dynamic_update_slice(
             cache["mask"], token_mask.astype(cache["mask"].dtype), (0, index)
         )
-        bias = decode_bias(new_mask, t)
+        bias = decode_bias(new_mask, t_ext)
         if self.cfg.alibi:
             bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
         if self.cfg.sliding_window is not None:
@@ -603,18 +664,25 @@ class TransformerLM(nn.Module):
         if is_prefill:
             # causal structure within the prefill block
             S = cache["mask"].shape[-1]
-            q_ids = jnp.arange(t)[:, None]
+            q_ids = jnp.arange(t_ext)[:, None]
             k_ids = jnp.arange(S)[None, :]
-            within = (k_ids < index + t) & (k_ids >= index) & (k_ids - index > q_ids)
+            within = (k_ids < index + t_ext) & (k_ids >= index) & (k_ids - index > q_ids)
             bias = bias + jnp.where(within[None, None], -1e9, 0.0).astype(jnp.float32)
 
-        h = self.embed(tokens, positions)
+        if P > 0:
+            h = jnp.concatenate(
+                [self._embed_soft_prompt(b, positions[:, :P]),
+                 self.embed(tokens, positions[:, P:])],
+                axis=1,
+            )
+        else:
+            h = self.embed(tokens, positions)
         h, new_layers = self.run_blocks(
             h, bias, positions, 0, self.cfg.n_layers, cache=cache["layers"], cache_index=index
         )
-        logits, h = self.unembed(h)
+        logits, h = self.unembed(h[:, P:] if P > 0 else h)
         new_cache = {
-            "index": index + t,
+            "index": index + t_ext,
             "mask": new_mask,
             "pos": next_pos,
             "layers": new_layers,
@@ -630,8 +698,11 @@ def position_ids(attn_mask: jnp.ndarray) -> jnp.ndarray:
 
 
 def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int, dtype=None):
-    """Allocate an empty functional KV cache."""
+    """Allocate an empty functional KV cache. Under prompt tuning the soft
+    prompt occupies the first cfg.prompt_tokens cache slots (written by the
+    prefill), so the cache is allocated that much longer."""
     dtype = dtype or cfg.dtype
+    max_len = max_len + getattr(cfg, "prompt_tokens", 0)
     layers = [
         {
             "k": jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), dtype=dtype),

@@ -88,6 +88,21 @@ class TPUTrainer(BaseRLTrainer):
 
         # Model + params (sharded onto the mesh by the rule table)
         self.model, self.model_cfg, params = self.get_arch(config)
+        P = getattr(self.model_cfg, "prompt_tokens", 0)
+        if (
+            P > 0
+            and getattr(self.model_cfg, "pos_embed", None) == "learned"
+            and config.train.seq_length + P > self.model_cfg.max_seq_len
+        ):
+            # the soft prompt shifts real-token positions by P; past the
+            # learned-position table the embedding gather would clamp
+            # silently, so fail loudly up front
+            raise ValueError(
+                f"prompt_tokens={P} + train.seq_length="
+                f"{config.train.seq_length} exceeds the learned-position "
+                f"table ({self.model_cfg.max_seq_len}); lower seq_length by "
+                "the prompt length"
+            )
         self.split = resolve_split(self.model_cfg, config.model.num_layers_unfrozen)
         params = self.place_params(params)
 
@@ -799,6 +814,19 @@ class TPUTrainer(BaseRLTrainer):
                 from trlx_tpu.models.lora import merge_lora_into_params
 
                 params = merge_lora_into_params(params, self.model_cfg)
+            if getattr(self.model_cfg, "prompt_tokens", 0) > 0:
+                # HF base checkpoints have no slot for the soft prompt (the
+                # only trained LM params) — export it alongside, like peft's
+                # adapter-only checkpoints, and say so loudly
+                np.save(
+                    os.path.join(directory, "soft_prompt.npy"),
+                    np.asarray(params["lm"]["soft_prompt"], np.float32),
+                )
+                logger.warning(
+                    "Prompt-tuning export: pytorch_model.bin holds the "
+                    "UNMODIFIED base weights; the trained soft prompt is in "
+                    "soft_prompt.npy (prepend its embeddings to use it)"
+                )
             sd = params_to_hf_state_dict(params, self.model_cfg)
             torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
                        os.path.join(directory, "pytorch_model.bin"))

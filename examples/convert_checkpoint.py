@@ -1,0 +1,106 @@
+"""Offline checkpoint converter: HF <-> trlx_tpu layouts.
+
+Role parity with the reference's examples/llama_nemo/convert_llama_to_nemo.py
+(convert an HF Llama checkpoint into the large-model backend's native
+layout before training). trlx_tpu converts HF weights on the fly at
+`build_model` time, but converting once offline avoids re-running the
+torch-side conversion on every pod worker at startup:
+
+    # HF checkpoint dir -> trlx_tpu flax msgpack (+ config json)
+    python examples/convert_checkpoint.py to-tpu  /path/to/hf_model out_dir/
+
+    # trained trlx_tpu msgpack -> HF-layout pytorch_model.bin
+    python examples/convert_checkpoint.py to-hf   out_dir/           hf_out/
+
+`to-tpu` writes `params.msgpack` + `model_config.json`; training then loads
+it via `TRLX_TPU_MODEL_DIR`-style local paths (no hub access needed —
+this environment has no egress). `to-hf` is the reverse for serving a
+trained policy from any HF stack.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def to_tpu(src: str, out: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    from flax import serialization
+
+    from trlx_tpu.models import CausalLMWithValueHead, hf_interop
+
+    cfg = hf_interop.config_from_hf(src, dtype=jnp.bfloat16)
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    params = hf_interop.load_params_from_hf(src, cfg, template)
+
+    os.makedirs(out, exist_ok=True)
+    with open(os.path.join(out, "params.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(params))
+    # keep the source HF config so `to-hf` can round-trip without the
+    # original checkpoint dir
+    import shutil
+
+    shutil.copy(os.path.join(src, "config.json"), os.path.join(out, "config.json"))
+    from dataclasses import asdict
+
+    with open(os.path.join(out, "model_config.json"), "w") as f:
+        json.dump({k: str(v) for k, v in asdict(cfg).items()}, f, indent=2)
+    n = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+    print(f"wrote {out}/params.msgpack ({n:,} params, family={cfg.hf_family})")
+
+
+def to_hf(src: str, out: str) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+    from flax import serialization
+
+    from trlx_tpu.models import CausalLMWithValueHead, hf_interop
+
+    with open(os.path.join(src, "model_config.json")) as f:
+        raw = json.load(f)
+    # config json stores everything stringified; rebuild via the HF config
+    # if present, else refuse (the msgpack alone doesn't carry structure)
+    hf_src = raw.get("hf_family")
+    cfg = hf_interop.config_from_hf(src) if os.path.exists(
+        os.path.join(src, "config.json")
+    ) else None
+    if cfg is None:
+        sys.exit("to-hf needs the original HF config.json alongside params.msgpack")
+    model = CausalLMWithValueHead(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    with open(os.path.join(src, "params.msgpack"), "rb") as f:
+        params = serialization.from_bytes(template, f.read())
+
+    sd = hf_interop.params_to_hf_state_dict(params, cfg)
+    os.makedirs(out, exist_ok=True)
+    torch.save(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+        os.path.join(out, "pytorch_model.bin"),
+    )
+    import shutil
+
+    # from_pretrained needs config.json next to the weights
+    shutil.copy(os.path.join(src, "config.json"), os.path.join(out, "config.json"))
+    print(f"wrote {out}/pytorch_model.bin ({len(sd)} tensors, family={hf_src})")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("direction", choices=["to-tpu", "to-hf"])
+    p.add_argument("src")
+    p.add_argument("out")
+    args = p.parse_args()
+    (to_tpu if args.direction == "to-tpu" else to_hf)(args.src, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -341,6 +341,12 @@ def test_ppo_trainer_with_prompt_tuning(tmp_path):
     )
     assert not np.allclose(before, after), "soft prompt did not move"
 
+    # second experience pass AFTER a train step: the jitted step donates the
+    # trainable soft prompt, so ref_params must not alias it (a stale alias
+    # crashes here with "Array has been deleted")
+    trainer.store.clear_history()
+    trainer.make_experience(8)
+
 
 def test_prompt_tuning_learned_pos_budget_guard(tmp_path):
     """Soft prompt + learned positions: seq_length must leave room in the

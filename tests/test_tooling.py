@@ -106,3 +106,41 @@ def test_sweep_end_to_end(tmp_path):
     assert summary["best"] is not None
     assert summary["best"]["returncode"] == 0, "trial subprocess failed"
     assert np.isfinite(summary["best"]["reward/mean"])
+
+
+def test_convert_checkpoint_round_trip(tmp_path):
+    """examples/convert_checkpoint.py (role of the reference's
+    convert_llama_to_nemo.py): HF -> trlx_tpu msgpack -> HF round trip
+    preserves weights."""
+    import subprocess
+    import sys
+
+    torch = pytest.importorskip("torch")
+    import transformers as tf
+
+    torch.manual_seed(0)
+    hf = tf.GPT2LMHeadModel(
+        tf.GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2, n_head=2)
+    )
+    hf.save_pretrained(str(tmp_path / "src"), safe_serialization=True)
+
+    script = os.path.join(os.path.dirname(__file__), "..", "examples", "convert_checkpoint.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r1 = subprocess.run(
+        [sys.executable, script, "to-tpu", str(tmp_path / "src"), str(tmp_path / "tpu")],
+        capture_output=True, text=True, env=env,
+    )
+    assert r1.returncode == 0, r1.stderr[-800:]
+    assert (tmp_path / "tpu" / "params.msgpack").exists()
+    r2 = subprocess.run(
+        [sys.executable, script, "to-hf", str(tmp_path / "tpu"), str(tmp_path / "back")],
+        capture_output=True, text=True, env=env,
+    )
+    assert r2.returncode == 0, r2.stderr[-800:]
+
+    sd0 = hf.state_dict()
+    sd1 = torch.load(str(tmp_path / "back" / "pytorch_model.bin"), weights_only=True)
+    key = "transformer.h.0.attn.c_attn.weight"
+    np.testing.assert_allclose(
+        sd0[key].numpy(), sd1[key].float().numpy(), atol=1e-2  # bf16 round trip
+    )

@@ -193,9 +193,12 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
 
         return zero_lora(lm)
     if getattr(cfg, "prompt_tokens", 0) > 0:
-        # base weights are all frozen under prompt tuning (never donated),
-        # and the ref forward runs with use_prompt=False — alias, no copy
-        return lm
+        # base weights are all frozen under prompt tuning (never donated) —
+        # alias them. The soft prompt is the one TRAINABLE lm leaf: the
+        # jitted train step donates (deletes) its buffer, so it must be a
+        # copy even though the ref forward (use_prompt=False) never reads
+        # it (flax setup still materializes the param).
+        return {**lm, "soft_prompt": jnp.copy(lm["soft_prompt"])}
     if split == 0:
         return jax.tree_util.tree_map(jnp.copy, lm)
     subtree = {}
@@ -224,7 +227,7 @@ def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: in
         if prompt:
             # prompt-tuning peft semantics: only the soft prompt (+ heads
             # above) trains; every base LM weight is frozen.
-            return any(str(getattr(k, "key", k)) == "soft_prompt" for k in path_keys)
+            return "soft_prompt" in parts
         if lora:
             # peft semantics: only adapters (+ heads above) train; every
             # base LM weight is frozen regardless of num_layers_unfrozen.

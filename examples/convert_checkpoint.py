@@ -36,6 +36,8 @@ def to_tpu(src: str, out: str) -> None:
     cfg = hf_interop.config_from_hf(src, dtype=jnp.bfloat16)
     model = CausalLMWithValueHead(cfg)
     tokens = jnp.zeros((1, 8), jnp.int32)
+    # real init, not eval_shape: the head (and any adapter) leaves are kept
+    # from the template and must be materialized arrays for serialization
     template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
     params = hf_interop.load_params_from_hf(src, cfg, template)
 
@@ -64,19 +66,16 @@ def to_hf(src: str, out: str) -> None:
 
     from trlx_tpu.models import CausalLMWithValueHead, hf_interop
 
-    with open(os.path.join(src, "model_config.json")) as f:
-        raw = json.load(f)
-    # config json stores everything stringified; rebuild via the HF config
-    # if present, else refuse (the msgpack alone doesn't carry structure)
-    hf_src = raw.get("hf_family")
-    cfg = hf_interop.config_from_hf(src) if os.path.exists(
-        os.path.join(src, "config.json")
-    ) else None
-    if cfg is None:
-        sys.exit("to-hf needs the original HF config.json alongside params.msgpack")
+    if not os.path.exists(os.path.join(src, "config.json")):
+        sys.exit("to-hf needs the HF config.json alongside params.msgpack "
+                 "(to-tpu copies it into its output dir)")
+    cfg = hf_interop.config_from_hf(src)
     model = CausalLMWithValueHead(cfg)
     tokens = jnp.zeros((1, 8), jnp.int32)
-    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    # from_bytes only needs structure, so the shape-only template suffices
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))
+    )["params"]
     with open(os.path.join(src, "params.msgpack"), "rb") as f:
         params = serialization.from_bytes(template, f.read())
 
@@ -90,7 +89,7 @@ def to_hf(src: str, out: str) -> None:
 
     # from_pretrained needs config.json next to the weights
     shutil.copy(os.path.join(src, "config.json"), os.path.join(out, "config.json"))
-    print(f"wrote {out}/pytorch_model.bin ({len(sd)} tensors, family={hf_src})")
+    print(f"wrote {out}/pytorch_model.bin ({len(sd)} tensors, family={cfg.hf_family})")
 
 
 def main():

@@ -43,6 +43,10 @@ class GenerationConfig:
     eos_token_id: int = 0
     pad_token_id: int = 0
     min_new_tokens: int = 0
+    # HF RepetitionPenaltyLogitsProcessor (the NeMo generate default,
+    # modeling_nemo_ppo.py:1169): tokens seen so far (prompt included) get
+    # positive logits divided / negative logits multiplied by this
+    repetition_penalty: float = 1.0
     # ILQL advantage shift (reference gen_kwargs beta, default_configs.py:92)
     beta: float = 1.0
 
@@ -57,6 +61,7 @@ class GenerationConfig:
             top_p=float(kw.get("top_p", 1.0)),
             do_sample=bool(kw.get("do_sample", True)),
             min_new_tokens=int(kw.get("min_new_tokens", 0) or 0),
+            repetition_penalty=float(kw.get("repetition_penalty", 1.0) or 1.0),
             beta=float(kw.get("beta", 1.0)),
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
@@ -67,10 +72,16 @@ def process_logits(
     logits: jnp.ndarray,  # [b, V] f32
     cfg: GenerationConfig,
     step: jnp.ndarray,
+    seen: Optional[jnp.ndarray] = None,  # [b, V] bool: token appeared so far
 ) -> jnp.ndarray:
-    """Temperature / top-k / top-p / min-new-tokens logit processing,
-    matching HF LogitsProcessor order (temperature -> top_k -> top_p)."""
+    """Repetition-penalty / temperature / top-k / top-p / min-new-tokens
+    logit processing, matching HF LogitsProcessor order (repetition ->
+    temperature -> top_k -> top_p)."""
     logits = logits.astype(jnp.float32)
+    if cfg.repetition_penalty != 1.0 and seen is not None:
+        p = cfg.repetition_penalty
+        penalized = jnp.where(logits > 0, logits / p, logits * p)
+        logits = jnp.where(seen, penalized, logits)
     if cfg.min_new_tokens > 0:
         # forbid EOS before min_new_tokens
         eos_penalty = jnp.where(step < cfg.min_new_tokens, -jnp.inf, 0.0)
@@ -138,23 +149,32 @@ def make_generate_fn(
             logits = jax.nn.log_softmax(logits, axis=-1) + gen_cfg.beta * adv
         return logits
 
-    def decode_loop(rng, cache, last_logits, last_adv, prev_token0, params, b, token_dtype):
+    def decode_loop(rng, cache, last_logits, last_adv, prev_token0, params, b, token_dtype,
+                    seen0=None):
         if last_adv is None:
             last_adv = jnp.zeros((b, 1), dtype=jnp.float32)
+        track_seen = gen_cfg.repetition_penalty != 1.0
+        if track_seen and seen0 is None:
+            raise ValueError(
+                "repetition_penalty != 1 requires an initial seen-token mask"
+            )
+        if not track_seen:
+            # dummy 1-wide when unused so the while_loop carry stays tiny
+            seen0 = jnp.zeros((b, 1), dtype=bool)
         out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=token_dtype)
         out_mask0 = jnp.zeros((b, max_new), dtype=jnp.int32)
         finished0 = jnp.zeros((b,), dtype=bool)
-        state = (0, rng, cache, last_logits, last_adv, prev_token0, out_tokens0, out_mask0, finished0)
+        state = (0, rng, cache, last_logits, last_adv, prev_token0, out_tokens0, out_mask0,
+                 finished0, seen0)
 
         def cond(state):
-            i, _, _, _, _, _, _, _, finished = state
-            return (i < max_new) & ~jnp.all(finished)
+            return (state[0] < max_new) & ~jnp.all(state[8])
 
         def body(state):
-            i, rng, cache, logits, adv, prev_token, out_tokens, out_mask, finished = state
+            i, rng, cache, logits, adv, prev_token, out_tokens, out_mask, finished, seen = state
             rng, key = jax.random.split(rng)
             scores = shift_logits(logits, adv, prev_token)
-            scores = process_logits(scores, gen_cfg, i)
+            scores = process_logits(scores, gen_cfg, i, seen if track_seen else None)
             if gen_cfg.do_sample and gen_cfg.temperature != 0.0:
                 token = jax.random.categorical(key, scores, axis=-1)
             else:
@@ -163,6 +183,8 @@ def make_generate_fn(
             token = jnp.where(finished, gen_cfg.pad_token_id, token)
             valid = (~finished).astype(jnp.int32)
             finished = finished | (token == gen_cfg.eos_token_id)
+            if track_seen:
+                seen = seen.at[jnp.arange(b), token].set(True)
 
             out_tokens = jax.lax.dynamic_update_slice(out_tokens, token[:, None], (0, i))
             out_mask = jax.lax.dynamic_update_slice(out_mask, valid[:, None], (0, i))
@@ -170,18 +192,27 @@ def make_generate_fn(
             logits, adv, cache = step_model(params, token[:, None], cache, valid[:, None], False)
             if adv is None:
                 adv = jnp.zeros((b, 1), dtype=jnp.float32)
-            return (i + 1, rng, cache, logits, adv, token, out_tokens, out_mask, finished)
+            return (i + 1, rng, cache, logits, adv, token, out_tokens, out_mask, finished, seen)
 
-        (_, _, _, _, _, _, out_tokens, out_mask, _) = jax.lax.while_loop(cond, body, state)
-        return out_tokens, out_mask
+        final = jax.lax.while_loop(cond, body, state)
+        return final[6], final[7]
 
     def generate(params, input_ids, attn_mask, rng):
         b, plen = input_ids.shape
         total = plen + max_new
         cache = init_kv_cache(model_cfg, b, total)
         last_logits, last_adv, cache = step_model(params, input_ids, cache, attn_mask, True)
+        seen0 = None
+        if gen_cfg.repetition_penalty != 1.0:
+            # HF semantics: the penalty covers prompt tokens too
+            counts = jnp.zeros((b, model_cfg.vocab_size), jnp.int32)
+            counts = counts.at[jnp.arange(b)[:, None], input_ids].add(
+                attn_mask.astype(jnp.int32)
+            )
+            seen0 = counts > 0
         out_tokens, out_mask = decode_loop(
-            rng, cache, last_logits, last_adv, input_ids[:, -1], params, b, input_ids.dtype
+            rng, cache, last_logits, last_adv, input_ids[:, -1], params, b, input_ids.dtype,
+            seen0,
         )
         samples = jnp.concatenate([input_ids, out_tokens], axis=1)
         samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
@@ -209,8 +240,15 @@ def make_generate_fn(
         start = jnp.full((b, 1), start_id, dtype=input_ids.dtype)
         ones = jnp.ones((b, 1), dtype=jnp.int32)
         last_logits, last_adv, cache = step_model(params, start, cache, ones, True)
+        seen0 = None
+        if gen_cfg.repetition_penalty != 1.0:
+            # decoder-side tokens only (HF penalizes decoder input_ids)
+            seen0 = jnp.zeros((b, model_cfg.vocab_size), bool).at[
+                jnp.arange(b), start_id
+            ].set(True)
         out_tokens, out_mask = decode_loop(
-            rng, cache, last_logits, last_adv, start[:, 0], params, b, input_ids.dtype
+            rng, cache, last_logits, last_adv, start[:, 0], params, b, input_ids.dtype,
+            seen0,
         )
         samples = jnp.concatenate([start, out_tokens], axis=1)
         samples_mask = jnp.concatenate([ones, out_mask], axis=1)

@@ -118,10 +118,12 @@ def build_model(
     cfg = resolve_transformer_config(model_config, vocab_size)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    if num_value_layers > 0 and getattr(cfg, "prompt_tokens", 0) > 0:
+    if num_value_layers > 0 and (
+        getattr(cfg, "prompt_tokens", 0) > 0 or getattr(cfg, "prefix_tokens", 0) > 0
+    ):
         raise NotImplementedError(
-            "num_value_layers_unfrozen with prompt tuning is not supported "
-            "(the reference likewise leaves peft off the value branch)"
+            "num_value_layers_unfrozen with prompt/prefix tuning is not "
+            "supported (the reference likewise leaves peft off the value branch)"
         )
     if is_seq2seq_config(cfg):
         if num_value_layers > 0:

@@ -479,8 +479,9 @@ def load_params_from_hf(path: str, cfg: TransformerConfig, params_template: Dict
 
     lora_leaves, base_flat = split_lora(params_template["lm"])
     adapter_leaves = dict(lora_leaves)
-    if ("soft_prompt",) in base_flat:
-        adapter_leaves[("soft_prompt",)] = base_flat.pop(("soft_prompt",))
+    for key in list(base_flat):
+        if "soft_prompt" in key or key[-1] in ("prefix_k", "prefix_v"):
+            adapter_leaves[key] = base_flat.pop(key)
     base_tpl = traverse_util.unflatten_dict(base_flat)
     mapped = jax.tree_util.tree_map(dt, base_tpl, lm)
     new_lm = traverse_util.unflatten_dict(

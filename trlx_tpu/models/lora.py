@@ -45,9 +45,17 @@ def lora_overrides_from_peft_config(peft_config: Any) -> Dict[str, Any]:
         # modeling_ppo.py:324-327): trainable virtual embeddings prepended
         # to every sequence, base weights frozen
         return {"prompt_tokens": int(peft_config.get("num_virtual_tokens", 8))}
+    if peft_type == "PREFIX_TUNING":
+        # per-layer trainable K/V prefixes (reference prefix bypass,
+        # modeling_ppo.py:314-327). attn_impl is NOT injected here — "xla"
+        # (the dense-bias path the prefixes need) is already the default,
+        # and injecting would collide with a user-supplied attn_impl;
+        # TransformerConfig.__post_init__ rejects fused impls loudly.
+        return {"prefix_tokens": int(peft_config.get("num_virtual_tokens", 8))}
     if peft_type != "LORA":
         raise ValueError(
-            f"Unsupported peft_type '{peft_type}' (LORA and PROMPT_TUNING)"
+            f"Unsupported peft_type '{peft_type}' "
+            "(LORA, PROMPT_TUNING, PREFIX_TUNING)"
         )
     overrides: Dict[str, Any] = {"lora_rank": int(peft_config.get("r", 8))}
     if "lora_alpha" in peft_config:

@@ -159,10 +159,10 @@ def resolve_split(cfg: TransformerConfig, num_layers_unfrozen: int) -> int:
     trlx_tpu/models/lora.py:zero_lora)."""
     if getattr(cfg, "lora_rank", 0) > 0:
         return 0
-    if getattr(cfg, "prompt_tokens", 0) > 0:
-        # the soft prompt changes every hidden state from layer 0 on, so the
-        # branch-point trick is invalid — ref logits come from a full
-        # prompt-free forward (forward_ref_full with use_prompt=False)
+    if getattr(cfg, "prompt_tokens", 0) > 0 or getattr(cfg, "prefix_tokens", 0) > 0:
+        # prompt/prefix adapters change every hidden state from layer 0 on,
+        # so the branch-point trick is invalid — ref logits come from a full
+        # adapter-free forward (forward_ref_full with use_prompt=False)
         return 0
     if num_layers_unfrozen == -1:
         return 0
@@ -192,13 +192,19 @@ def ref_param_subtree(params: Dict, cfg: TransformerConfig, split: int) -> Dict:
         from trlx_tpu.models.lora import zero_lora
 
         return zero_lora(lm)
-    if getattr(cfg, "prompt_tokens", 0) > 0:
-        # base weights are all frozen under prompt tuning (never donated) —
-        # alias them. The soft prompt is the one TRAINABLE lm leaf: the
-        # jitted train step donates (deletes) its buffer, so it must be a
-        # copy even though the ref forward (use_prompt=False) never reads
-        # it (flax setup still materializes the param).
-        return {**lm, "soft_prompt": jnp.copy(lm["soft_prompt"])}
+    if getattr(cfg, "prompt_tokens", 0) > 0 or getattr(cfg, "prefix_tokens", 0) > 0:
+        # base weights are all frozen under prompt/prefix tuning (never
+        # donated) — alias them. The adapter leaves are the TRAINABLE lm
+        # leaves: the jitted train step donates (deletes) their buffers, so
+        # they must be copies even though the ref forward (use_prompt=False)
+        # never reads them (flax setup still materializes the params).
+        def _copy_adapters(path_keys, leaf):
+            parts = [str(getattr(k, "key", k)) for k in path_keys]
+            if "soft_prompt" in parts or parts[-1] in ("prefix_k", "prefix_v"):
+                return jnp.copy(leaf)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(_copy_adapters, lm)
     if split == 0:
         return jax.tree_util.tree_map(jnp.copy, lm)
     subtree = {}
@@ -219,15 +225,16 @@ def trainable_mask(params: Dict, cfg: TransformerConfig, num_layers_unfrozen: in
     split = resolve_split(cfg, num_layers_unfrozen)
     lora = getattr(cfg, "lora_rank", 0) > 0
     prompt = getattr(cfg, "prompt_tokens", 0) > 0
+    prefix = getattr(cfg, "prefix_tokens", 0) > 0
 
     def _mask(path_keys, leaf):
         parts = [getattr(k, "key", str(k)) for k in path_keys]
         if parts[0] != "lm":
             return True  # v_head / ilql_heads / any auxiliary head
-        if prompt:
-            # prompt-tuning peft semantics: only the soft prompt (+ heads
-            # above) trains; every base LM weight is frozen.
-            return "soft_prompt" in parts
+        if prompt or prefix:
+            # prompt/prefix-tuning peft semantics: only the adapter leaves
+            # (+ heads above) train; every base LM weight is frozen.
+            return "soft_prompt" in parts or str(parts[-1]) in ("prefix_k", "prefix_v")
         if lora:
             # peft semantics: only adapters (+ heads above) train; every
             # base LM weight is frozen regardless of num_layers_unfrozen.

@@ -74,6 +74,11 @@ class TransformerConfig:
                 "LoRA adapters on MoE expert weights are not supported; "
                 "set moe_experts=0 or lora_rank=0"
             )
+        if self.prefix_tokens > 0 and self.attn_impl != "xla":
+            raise NotImplementedError(
+                "prefix tuning needs the dense-bias attention path; set "
+                "attn_impl='xla'"
+            )
     # HF family tag recorded at conversion time so save_pretrained exports
     # the exact source layout (structure-based inference is ambiguous, e.g.
     # non-MQA GPTBigCode vs GPT-2); None = infer from structure.
@@ -90,6 +95,11 @@ class TransformerConfig:
     # many trainable soft-prompt embeddings to every sequence; the base
     # weights freeze and reference logits use a prompt-free forward.
     prompt_tokens: int = 0
+    # Prefix tuning (peft PREFIX_TUNING — the reference's prefix bypass,
+    # modeling_ppo.py:314-327): > 0 gives every attention layer that many
+    # trainable key/value prefix slots, visible to all queries; base
+    # weights freeze and reference logits use a prefix-free forward.
+    prefix_tokens: int = 0
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
     # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
@@ -245,6 +255,7 @@ class Attention(nn.Module):
         layer_cache: Optional[Dict[str, jnp.ndarray]] = None,
         cache_index: Optional[jnp.ndarray] = None,
         attn_mask: Optional[jnp.ndarray] = None,  # [b, t] key validity (fused paths)
+        use_prefix: bool = True,
     ):
         cfg = self.cfg
         b, t, d = h.shape
@@ -267,6 +278,29 @@ class Attention(nn.Module):
             cv = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv}
+
+        if cfg.prefix_tokens > 0:
+            # Prefix tuning: trainable K/V slots every query may attend to
+            # (peft PREFIX_TUNING past_key_values, unrotated like a cache).
+            # Params exist regardless of use_prefix (param structure must
+            # not depend on call args); the ref forward skips the concat.
+            P = cfg.prefix_tokens
+            pk = self.param("prefix_k", nn.initializers.normal(stddev=0.02),
+                            (P, nkv, hd), cfg.param_dtype)
+            pv = self.param("prefix_v", nn.initializers.normal(stddev=0.02),
+                            (P, nkv, hd), cfg.param_dtype)
+            if use_prefix:
+                k = jnp.concatenate(
+                    [jnp.broadcast_to(pk[None].astype(k.dtype), (b, P, nkv, hd)), k], axis=1
+                )
+                v = jnp.concatenate(
+                    [jnp.broadcast_to(pv[None].astype(v.dtype), (b, P, nkv, hd)), v], axis=1
+                )
+                # prefix columns are visible to every query
+                attn_bias = jnp.concatenate(
+                    [jnp.zeros(attn_bias.shape[:3] + (P,), attn_bias.dtype), attn_bias],
+                    axis=-1,
+                )
 
         if fused_attention_ok(cfg, t) and layer_cache is None and attn_mask is not None:
             # Fused training/scoring path: causal + key-padding structure is
@@ -381,11 +415,12 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None, attn_mask=None):
+    def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None, attn_mask=None,
+                 use_prefix=True):
         cfg = self.cfg
         h_ln = make_norm(cfg, "ln_attn")(h)
         attn_out, new_cache = Attention(cfg, name="attn")(
-            h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask
+            h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix
         )
         mlp_cls = MoEMLP if cfg.moe_experts > 0 else MLP
         if cfg.parallel_residual:
@@ -522,11 +557,11 @@ class TransformerLM(nn.Module):
     def _train_bias(self, attn_mask):
         return train_bias(self.cfg, attn_mask)
 
-    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None):
+    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None, use_prefix: bool = True):
         new_layers = [] if cache is not None else None
         for i in range(start, stop):
             layer_cache = cache[i] if cache is not None else None
-            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index, attn_mask)
+            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix)
             if cache is not None:
                 new_layers.append(new_cache)
         return h, new_layers
@@ -604,7 +639,8 @@ class TransformerLM(nn.Module):
         bounds = sorted({0, split, value_split, self.cfg.n_layers})
         for s, e in zip(bounds, bounds[1:]):
             caps[s] = h
-            h, _ = self.run_blocks(h, bias, positions, s, e, attn_mask=attn_mask)
+            h, _ = self.run_blocks(h, bias, positions, s, e, attn_mask=attn_mask,
+                                   use_prefix=use_prompt)
         caps[self.cfg.n_layers] = h
         logits, h_final = self.unembed(h[:, P:] if P > 0 else h)
         return logits, caps[split], h_final, caps[value_split]

@@ -103,8 +103,9 @@ GPT_RULES = ShardingRules(
         # same output sharding as the base projection it adds into.
         (r"\w+_lora_a", ("fsdp", None)),
         (r"\w+_lora_b", (None, "tensor")),
-        # prompt-tuning soft prompt: tiny [P, d] — replicate
+        # prompt/prefix-tuning adapters: tiny — replicate
         (r"soft_prompt", (None, None)),
+        (r"prefix_[kv]$", (None, None, None)),
         # MoE: expert dim over `tensor` (expert parallelism); router
         # replicated so every device can gate every token.
         (r"mlp/router/kernel", (None, None)),

@@ -827,6 +827,22 @@ class TPUTrainer(BaseRLTrainer):
                     "UNMODIFIED base weights; the trained soft prompt is in "
                     "soft_prompt.npy (prepend its embeddings to use it)"
                 )
+            if getattr(self.model_cfg, "prefix_tokens", 0) > 0:
+                np.savez(
+                    os.path.join(directory, "prefix_kv.npz"),
+                    **{
+                        f"block_{i}.attn.{kv}": np.asarray(
+                            params["lm"][f"block_{i}"]["attn"][kv], np.float32
+                        )
+                        for i in range(self.model_cfg.n_layers)
+                        for kv in ("prefix_k", "prefix_v")
+                    },
+                )
+                logger.warning(
+                    "Prefix-tuning export: pytorch_model.bin holds the "
+                    "UNMODIFIED base weights; the trained K/V prefixes are "
+                    "in prefix_kv.npz"
+                )
             sd = params_to_hf_state_dict(params, self.model_cfg)
             torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
                        os.path.join(directory, "pytorch_model.bin"))

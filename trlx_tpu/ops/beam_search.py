@@ -1,0 +1,184 @@
+"""Jitted beam search for the sampling engine.
+
+The reference gets beam search from HF `model.generate(num_beams=...)`
+(used by its seq2seq examples, e.g. examples/ppo_translation_t5.py:99);
+here it is a `lax.scan` over decode steps with the KV cache reordered by
+beam index each step. Deterministic (no sampling).
+
+Follows HF's BeamSearchScorer shape: each step takes the top `2*num_beams`
+candidates; candidates ending in EOS are banked into a per-row finished
+store (top-`num_beams` hypotheses by length-normalized score, denominator
+= `generated_len ** length_penalty` with generated_len counting tokens
+before the EOS and excluding the prompt/decoder start — HF
+BeamHypotheses.add semantics, where generated_len == 0 yields -inf), while
+the `num_beams` best non-EOS candidates continue as live beams. At the
+end, still-live beams join the pool at generated_len == max_new_tokens and
+the best normalized score wins.
+
+Output contract matches ops/sampling.py's generate: a dict with
+`samples` / `samples_mask` / `response_tokens` / `response_mask` holding
+the winning hypothesis per batch row.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from trlx_tpu.models.transformer import TransformerConfig, init_kv_cache
+
+NEG_INF = -1.0e9
+
+
+def _expand_rows(x, n_beams):
+    """[b, ...] -> [b*n_beams, ...] with each row repeated contiguously."""
+    return jnp.repeat(x, n_beams, axis=0)
+
+
+def _gather_beams(tree, beam_idx, b, n_beams):
+    """Reorder the flat [b*n_beams, ...] leaves of `tree` by per-row beam
+    indices [b, k]."""
+    flat_idx = (jnp.arange(b)[:, None] * n_beams + beam_idx).reshape(-1)
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf[flat_idx] if hasattr(leaf, "ndim") and leaf.ndim >= 1 else leaf,
+        tree,
+    )
+
+
+def make_beam_generate_fn(
+    model,
+    model_cfg: TransformerConfig,
+    gen_cfg,  # ops.sampling.GenerationConfig (num_beams > 1)
+) -> Callable:
+    """Build a jittable beam-search generate(params, input_ids, attn_mask,
+    rng) — rng accepted for interface parity, unused (deterministic)."""
+    B = gen_cfg.num_beams
+    max_new = gen_cfg.max_new_tokens
+    lp = gen_cfg.length_penalty
+    eos, pad = gen_cfg.eos_token_id, gen_cfg.pad_token_id
+    is_seq2seq = bool(getattr(model_cfg, "is_seq2seq", False))
+
+    def step_model(params, tokens, cache, token_mask, is_prefill):
+        logits, _, cache = model.apply(
+            {"params": params}, tokens, cache, token_mask, is_prefill,
+            method=type(model).decode_step,
+        )
+        return logits[:, -1].astype(jnp.float32), cache
+
+    def decode(params, cache, last_logits, b, token_dtype):
+        V = last_logits.shape[-1]
+        # beam 0 live, others -inf so step 1 picks B distinct tokens
+        scores0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (B - 1)), (b, 1))
+        state = (
+            cache,
+            last_logits,  # [b*B, V]
+            scores0,  # [b, B] live raw scores
+            jnp.full((b, B, max_new), pad, dtype=token_dtype),  # live tokens
+            jnp.full((b, B), NEG_INF),  # finished normalized scores
+            jnp.full((b, B, max_new), pad, dtype=token_dtype),  # finished tokens
+            jnp.zeros((b, B, max_new), jnp.int32),  # finished masks
+        )
+
+        def step(state, i):
+            cache, logits, scores, live_toks, fin_scores, fin_toks, fin_masks = state
+            logprobs = jax.nn.log_softmax(logits, axis=-1)  # [b*B, V]
+            if gen_cfg.min_new_tokens > 0:
+                block = jnp.where(i < gen_cfg.min_new_tokens, NEG_INF, 0.0)
+                logprobs = logprobs.at[:, eos].add(block)
+            total = scores[:, :, None] + logprobs.reshape(b, B, V)
+            # HF candidate pool: top 2B so EOS hits don't starve live beams
+            c_scores, c_idx = jax.lax.top_k(total.reshape(b, B * V), 2 * B)
+            c_beam = c_idx // V  # [b, 2B]
+            c_tok = (c_idx % V).astype(token_dtype)
+            is_eos = c_tok == eos
+
+            # --- bank EOS candidates into the finished store -------------
+            # generated_len excludes the EOS (= i); i == 0 -> -inf, like
+            # HF's score / 0**lp on a negative sum of logprobs
+            gen_len = jnp.maximum(i, 1).astype(jnp.float32)
+            cand_norm = jnp.where(
+                is_eos & (i > 0), c_scores / (gen_len ** lp), NEG_INF
+            )
+            cand_toks = jnp.take_along_axis(live_toks, c_beam[:, :, None], axis=1)
+            cand_toks = cand_toks.at[:, :, i].set(jnp.asarray(eos, token_dtype))
+            step_ids = jnp.arange(max_new)
+            cand_mask = (step_ids[None, None, :] <= i).astype(jnp.int32)
+            cand_mask = jnp.broadcast_to(cand_mask, (b, 2 * B, max_new))
+
+            all_scores = jnp.concatenate([fin_scores, cand_norm], axis=1)  # [b, 3B]
+            all_toks = jnp.concatenate([fin_toks, cand_toks], axis=1)
+            all_masks = jnp.concatenate([fin_masks, cand_mask], axis=1)
+            fin_scores, keep = jax.lax.top_k(all_scores, B)
+            fin_toks = jnp.take_along_axis(all_toks, keep[:, :, None], axis=1)
+            fin_masks = jnp.take_along_axis(all_masks, keep[:, :, None], axis=1)
+
+            # --- continue with the B best non-EOS candidates -------------
+            live_c = jnp.where(is_eos, NEG_INF, c_scores)
+            scores, pick = jax.lax.top_k(live_c, B)  # over the 2B pool
+            sel_beam = jnp.take_along_axis(c_beam, pick, axis=1)
+            sel_tok = jnp.take_along_axis(c_tok, pick, axis=1)
+            cache = _gather_beams(cache, sel_beam, b, B)
+            live_toks = jnp.take_along_axis(live_toks, sel_beam[:, :, None], axis=1)
+            live_toks = live_toks.at[:, :, i].set(sel_tok)
+
+            flat_tok = sel_tok.reshape(b * B, 1)
+            ones = jnp.ones((b * B, 1), jnp.int32)
+            logits, cache = step_model(params, flat_tok, cache, ones, False)
+            return (cache, logits, scores, live_toks, fin_scores, fin_toks, fin_masks), None
+
+        (cache, _, scores, live_toks, fin_scores, fin_toks, fin_masks), _ = jax.lax.scan(
+            step, state, jnp.arange(max_new)
+        )
+        # still-live beams enter the pool at generated_len == max_new
+        live_norm = scores / float(max_new) ** lp
+        live_masks = jnp.ones((b, B, max_new), jnp.int32)
+        all_scores = jnp.concatenate([fin_scores, live_norm], axis=1)
+        all_toks = jnp.concatenate([fin_toks, live_toks], axis=1)
+        all_masks = jnp.concatenate([fin_masks, live_masks], axis=1)
+        best = jnp.argmax(all_scores, axis=1)  # [b]
+        pick = lambda x: jnp.take_along_axis(x, best[:, None, None], axis=1)[:, 0]
+        return pick(all_toks), pick(all_masks)
+
+    def generate(params, input_ids, attn_mask, rng):
+        b, plen = input_ids.shape
+        ids = _expand_rows(input_ids, B)
+        mask = _expand_rows(attn_mask, B)
+        cache = init_kv_cache(model_cfg, b * B, plen + max_new)
+        last_logits, cache = step_model(params, ids, cache, mask, True)
+        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype)
+        samples = jnp.concatenate([input_ids, out_tokens], axis=1)
+        samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
+        return {
+            "samples": samples,
+            "samples_mask": samples_mask,
+            "response_tokens": out_tokens,
+            "response_mask": out_mask,
+        }
+
+    def generate_seq2seq(params, input_ids, attn_mask, rng):
+        b, _ = input_ids.shape
+        start_id = int(getattr(model_cfg, "decoder_start_token_id", pad))
+        enc_h = model.apply(
+            {"params": params}, input_ids, attn_mask, method=type(model).encode
+        )
+        enc_h = _expand_rows(enc_h, B)
+        enc_mask = _expand_rows(attn_mask, B)
+        cache = model.apply(
+            {"params": params}, enc_h, enc_mask, 1 + max_new,
+            method=type(model).prepare_cache,
+        )
+        start = jnp.full((b * B, 1), start_id, dtype=input_ids.dtype)
+        ones = jnp.ones((b * B, 1), jnp.int32)
+        last_logits, cache = step_model(params, start, cache, ones, True)
+        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype)
+        start_col = jnp.full((b, 1), start_id, dtype=input_ids.dtype)
+        samples = jnp.concatenate([start_col, out_tokens], axis=1)
+        samples_mask = jnp.concatenate([jnp.ones((b, 1), jnp.int32), out_mask], axis=1)
+        return {
+            "samples": samples,
+            "samples_mask": samples_mask,
+            "response_tokens": samples,
+            "response_mask": samples_mask,
+        }
+
+    return generate_seq2seq if is_seq2seq else generate

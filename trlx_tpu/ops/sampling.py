@@ -47,6 +47,10 @@ class GenerationConfig:
     # modeling_nemo_ppo.py:1169): tokens seen so far (prompt included) get
     # positive logits divided / negative logits multiplied by this
     repetition_penalty: float = 1.0
+    # > 1 switches to deterministic beam search (ops/beam_search.py — the
+    # reference's HF generate num_beams, e.g. ppo_translation_t5.py:99)
+    num_beams: int = 1
+    length_penalty: float = 1.0
     # ILQL advantage shift (reference gen_kwargs beta, default_configs.py:92)
     beta: float = 1.0
 
@@ -62,6 +66,8 @@ class GenerationConfig:
             do_sample=bool(kw.get("do_sample", True)),
             min_new_tokens=int(kw.get("min_new_tokens", 0) or 0),
             repetition_penalty=float(kw.get("repetition_penalty", 1.0) or 1.0),
+            num_beams=int(kw.get("num_beams", 1) or 1),
+            length_penalty=float(kw.get("length_penalty", 1.0) or 1.0),
             beta=float(kw.get("beta", 1.0)),
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
@@ -120,6 +126,31 @@ def make_generate_fn(
     max_new = gen_cfg.max_new_tokens
     forbid = jnp.asarray(logit_mask) if logit_mask is not None else None
     is_seq2seq = bool(getattr(model_cfg, "is_seq2seq", False))
+
+    if gen_cfg.num_beams > 1:
+        if mode != "lm" or logit_mask is not None:
+            raise NotImplementedError(
+                "num_beams > 1 supports plain LM generation only (no ILQL "
+                "advantage shift or transition logit masks)"
+            )
+        if (
+            gen_cfg.do_sample
+            or gen_cfg.temperature not in (0.0, 1.0)
+            or gen_cfg.top_k
+            or gen_cfg.top_p < 1.0
+            or gen_cfg.repetition_penalty != 1.0
+        ):
+            # refuse rather than silently running deterministic beam search
+            # where HF would beam-SAMPLE: byte-identical rollouts would
+            # quietly kill PPO exploration
+            raise NotImplementedError(
+                "num_beams > 1 is deterministic beam search: set "
+                "do_sample=False and leave temperature/top_k/top_p/"
+                "repetition_penalty at their defaults"
+            )
+        from trlx_tpu.ops.beam_search import make_beam_generate_fn
+
+        return make_beam_generate_fn(model, model_cfg, gen_cfg)
 
     def step_model(params, tokens, cache, token_mask, is_prefill):
         if mode == "ilql":

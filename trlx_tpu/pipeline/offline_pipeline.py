@@ -133,7 +133,7 @@ class DialogStore(BaseRolloutStore):
 
         return DataLoader(
             self.history, batch_size, shuffle=shuffle, collate_fn=collate,
-            seed=kwargs.get("seed", 0),
+            seed=kwargs.get("seed", 0), drop_last=kwargs.get("drop_last", False),
         )
 
 

@@ -24,6 +24,7 @@ for _mod in (
     "trlx_tpu.trainer.pipelined_ilql_trainer",
     "trlx_tpu.trainer.pipelined_ppo_trainer",
     "trlx_tpu.trainer.pipelined_rft_trainer",
+    "trlx_tpu.trainer.sequence_parallel_sft_trainer",
 ):
     try:
         __import__(_mod)

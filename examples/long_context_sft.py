@@ -1,0 +1,71 @@
+"""Long-context SFT with sequence (context) parallelism.
+
+The reference's longest trainable context is one TP group's memory under
+Megatron SP (SURVEY.md §5.7 — 2048 in every shipped config); this example
+trains with activations sharded along the sequence dim and ring attention
+streaming K/V around the `sequence` mesh axis, so context scales with
+chips. Offline-safe synthetic long documents; TRLX_TPU_MODEL_DIR switches
+to a real checkpoint.
+
+Run (virtual 8-device CPU mesh):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/long_context_sft.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) + "/..")
+
+import numpy as np
+
+import trlx_tpu as trlx
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.data.default_configs import default_sft_config
+
+local = os.environ.get("TRLX_TPU_MODEL_DIR")
+model_path = local if local and os.path.isdir(local) else "random:llama-tiny"
+tokenizer_path = local if local and os.path.isdir(local) else "byte"
+
+default_config = default_sft_config().evolve(
+    model=dict(model_path=model_path, num_layers_unfrozen=-1),
+    tokenizer=dict(tokenizer_path=tokenizer_path, padding_side="right"),
+    train=dict(
+        seq_length=2048,  # divisible by parallel.sequence
+        batch_size=8,
+        total_steps=100,
+        tracker=None,
+        trainer="SequenceParallelSFTTrainer",
+        checkpoint_dir="/tmp/trlx_tpu_ckpts/long_context_sft",
+    ),
+    method=dict(gen_kwargs=dict(max_new_tokens=32, do_sample=True)),
+    parallel=dict(data=2, sequence=4),
+)
+
+
+def make_documents(n=32, words=400, seed=0):
+    """Synthetic long documents (repeated clause structure so the LM has
+    something learnable at every position)."""
+    rng = np.random.default_rng(seed)
+    vocab = ("context parallel ring attention shards the sequence over chips "
+             "and streams key value blocks between neighbors").split()
+    return [
+        " ".join(rng.choice(vocab, size=words)) for _ in range(n)
+    ]
+
+
+def main(hparams={}):
+    config = TRLConfig.update(default_config.to_dict(), hparams)
+    words = max(8, config.train.seq_length // 6)  # ~fill the context
+    trainer = trlx.train(
+        samples=make_documents(words=words),
+        eval_prompts=["context parallel ring"] * min(4, config.train.batch_size),
+        config=config,
+    )
+    return trainer
+
+
+if __name__ == "__main__":
+    hparams = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    main(hparams)

@@ -46,6 +46,7 @@ EXAMPLES = [
     ("examples.simulacra", TINY),
     ("examples.grounded_program_synthesis", TINY_PPO),
     ("examples.sft_alpaca", {**TINY, "train.seq_length": 160}),
+    ("examples.long_context_sft", {**TINY, "train.seq_length": 64}),
     ("examples.summarize_daily_cnn_t5", TINY_PPO),
     ("examples.summarize_rlhf.train_sft", {**TINY, "train.seq_length": 96}),
     ("examples.hh.ppo_hh", TINY_PPO),

@@ -221,3 +221,30 @@ def test_model_flash_matches_xla():
     np.testing.assert_allclose(
         np.where(valid, lx, 0), np.where(valid, lf, 0), atol=2e-4, rtol=2e-4
     )
+
+
+def test_fully_masked_query_rows_have_finite_grads():
+    """Left-padded batches give fully-masked query rows; the blockwise/ring
+    backward must not blow up (regression: the finalize division clamp
+    multiplied upstream grads by 1e30 on the masked branch)."""
+    from trlx_tpu.parallel import MeshRuntime
+    from trlx_tpu.parallel.context import context_parallel_attention
+    from trlx_tpu.ops.attention import blockwise_attention
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(2, 16, 2, 8)).astype(np.float32))
+    mask = np.ones((2, 16), np.int32)
+    mask[0, :4] = 0  # left padding
+    mask = jnp.asarray(mask)
+
+    # deliberately do NOT mask the output: pad-row upstream grads flow
+    g = jax.grad(lambda q: jnp.sum(blockwise_attention(q, q, q, mask, True, 8) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+    runtime = MeshRuntime.from_config(
+        type("P", (), {"data": 2, "fsdp": 1, "tensor": 1, "sequence": 4})()
+    )
+    g2 = jax.grad(
+        lambda q: jnp.sum(context_parallel_attention(runtime.mesh, q, q, q, mask) ** 2)
+    )(q)
+    assert np.isfinite(np.asarray(g2)).all()

@@ -71,7 +71,13 @@ def _attend_block(q, k, v, bias, acc, m, l, scale):
 
 def _finalize(acc, l):
     l_t = l.transpose(0, 2, 1)[..., None]  # [b, tq, nh, 1]
-    return jnp.where(l_t > 0, acc / jnp.maximum(l_t, 1e-30), 0.0)
+    # Safe denominator, not just a clamp: with `maximum(l, 1e-30)` the
+    # backward of the (unselected) division branch multiplies upstream
+    # grads by 1e30 for fully-masked query rows (e.g. left-padding), which
+    # overflows to inf/NaN in the surrounding sums even though the forward
+    # is a clean 0.
+    l_safe = jnp.where(l_t > 0, l_t, 1.0)
+    return jnp.where(l_t > 0, acc / l_safe, 0.0)
 
 
 def init_carry(q32: jnp.ndarray):

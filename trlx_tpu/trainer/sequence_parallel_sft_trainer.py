@@ -48,24 +48,49 @@ from trlx_tpu.utils.modeling import logprobs_of_labels
 logger = logging.get_logger(__name__)
 
 
+def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> None:
+    """Shared constraints of the sequence-parallel trainers: a real
+    sequence axis, no fsdp/tensor/pipeline composition (params enter the
+    shard_map replicated — shard_map slices literally, so an fsdp-sharded
+    weight would be a partial matrix with no automatic gather), ring
+    attention forced, divisible seq_length, no MoE (the load-balancing aux
+    loss cannot cross the shard_map program). Mutates
+    config.model.model_extra_configs to pin attn_impl='ring'."""
+    pc = config.parallel
+    if pc.sequence <= 1:
+        raise ValueError(
+            f"{cls_name} requires parallel.sequence > 1 "
+            "(use the plain trainer otherwise)"
+        )
+    if pc.tensor != 1 or pc.fsdp != 1 or getattr(pc, "pipeline", 1) != 1:
+        raise NotImplementedError(
+            "sequence parallelism composes with the data axis only; "
+            "set parallel.fsdp/tensor/pipeline to 1"
+        )
+    if config.train.seq_length % pc.sequence != 0:
+        raise ValueError(
+            f"train.seq_length={config.train.seq_length} must divide "
+            f"into parallel.sequence={pc.sequence} shards"
+        )
+    extra = dict(config.model.model_extra_configs or {})
+    if extra.get("attn_impl", "ring") != "ring":
+        raise ValueError(
+            f"{cls_name} uses ring attention; leave "
+            "model_extra_configs.attn_impl unset or set it to 'ring'"
+        )
+    if extra.get("moe_experts", 0):
+        raise NotImplementedError(
+            "MoE under sequence parallelism is not supported yet (the "
+            "load-balancing aux loss cannot cross the shard_map program)"
+        )
+    extra["attn_impl"] = "ring"
+    config.model.model_extra_configs = extra
+
+
 @register_trainer
 class SequenceParallelSFTTrainer(SFTTrainer):
     def __init__(self, config: TRLConfig, **kwargs):
-        pc = config.parallel
-        if pc.sequence <= 1:
-            raise ValueError(
-                f"{type(self).__name__} requires parallel.sequence > 1 "
-                "(use the plain SFTTrainer otherwise)"
-            )
-        if pc.tensor != 1 or pc.fsdp != 1 or getattr(pc, "pipeline", 1) != 1:
-            # params enter the shard_map replicated (shard_map slices
-            # literally — an fsdp-sharded weight would be a partial matrix
-            # with no automatic gather), so claiming ZeRO composition here
-            # would silently replicate instead
-            raise NotImplementedError(
-                "sequence parallelism composes with the data axis only; "
-                "set parallel.fsdp/tensor/pipeline to 1"
-            )
+        validate_sequence_parallel_config(config, type(self).__name__)
         if config.tokenizer.padding_side != "right":
             # the ring position rule derives positions from the shard
             # offset, which is only correct for right-padded batches
@@ -73,24 +98,6 @@ class SequenceParallelSFTTrainer(SFTTrainer):
                 "SequenceParallelSFTTrainer requires tokenizer.padding_side"
                 " = 'right' (ring-attention positions assume right padding)"
             )
-        if config.train.seq_length % pc.sequence != 0:
-            raise ValueError(
-                f"train.seq_length={config.train.seq_length} must divide "
-                f"into parallel.sequence={pc.sequence} shards"
-            )
-        extra = dict(config.model.model_extra_configs or {})
-        if extra.get("attn_impl", "ring") != "ring":
-            raise ValueError(
-                "SequenceParallelSFTTrainer uses ring attention; leave "
-                "model_extra_configs.attn_impl unset or set it to 'ring'"
-            )
-        if extra.get("moe_experts", 0):
-            raise NotImplementedError(
-                "MoE under sequence parallelism is not supported yet (the "
-                "load-balancing aux loss cannot cross the shard_map program)"
-            )
-        extra["attn_impl"] = "ring"
-        config.model.model_extra_configs = extra
         super().__init__(config, **kwargs)
 
     def make_loss_fn(self) -> Callable:

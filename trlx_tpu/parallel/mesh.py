@@ -110,6 +110,12 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+# Primary double-init guard: set after a successful bootstrap in THIS module
+# so re-entry (e.g. a second trlx.train() in one process) no-ops without
+# depending on jax private state or error-message wording.
+_distributed_initialized = False
+
+
 def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -123,6 +129,11 @@ def initialize_distributed(
     §5.6) override for CPU/GPU fleets. No-op when single-process or
     already initialized."""
     import os
+
+    global _distributed_initialized
+    if _distributed_initialized:
+        logger.info("jax.distributed already initialized; skipping")
+        return
 
     coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
     if num_processes is None and "NUM_PROCESSES" in os.environ:
@@ -149,6 +160,7 @@ def initialize_distributed(
 
         if getattr(global_state, "client", None) is not None:
             logger.info("jax.distributed already initialized; skipping")
+            _distributed_initialized = True
             return
     except ImportError:  # private path moved: fall through to error matching
         pass
@@ -158,12 +170,14 @@ def initialize_distributed(
             num_processes=num_processes,
             process_id=process_id,
         )
+        _distributed_initialized = True
     except RuntimeError as e:
         # jax raises "distributed.initialize should only be called once."
         # on double init (older versions said "already initialized")
         msg = str(e).lower()
         if "once" in msg or "already" in msg:
             logger.info("jax.distributed already initialized; skipping")
+            _distributed_initialized = True
         elif "before any jax" in msg or "computations are executed" in msg:
             # The backend was touched before bootstrap (e.g. MeshRuntime
             # built directly without going through trlx_tpu.train). Loud

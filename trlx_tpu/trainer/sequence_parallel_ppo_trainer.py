@@ -53,7 +53,7 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             validate_sequence_parallel_config,
         )
 
-        validate_sequence_parallel_config(config, type(self).__name__)
+        config = validate_sequence_parallel_config(config, type(self).__name__)
         if config.model.model_arch_type != "causal":
             raise NotImplementedError("sequence-parallel PPO covers causal models")
         if getattr(config.method, "num_value_layers_unfrozen", 0):

@@ -48,14 +48,15 @@ from trlx_tpu.utils.modeling import logprobs_of_labels
 logger = logging.get_logger(__name__)
 
 
-def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> None:
+def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> TRLConfig:
     """Shared constraints of the sequence-parallel trainers: a real
     sequence axis, no fsdp/tensor/pipeline composition (params enter the
     shard_map replicated — shard_map slices literally, so an fsdp-sharded
     weight would be a partial matrix with no automatic gather), ring
     attention forced, divisible seq_length, no MoE (the load-balancing aux
-    loss cannot cross the shard_map program). Mutates
-    config.model.model_extra_configs to pin attn_impl='ring'."""
+    loss cannot cross the shard_map program). Returns a COPY of the config
+    with attn_impl='ring' pinned — the caller's config object is left
+    untouched so it can be reused with other trainer families."""
     pc = config.parallel
     if pc.sequence <= 1:
         raise ValueError(
@@ -84,13 +85,13 @@ def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> None:
             "load-balancing aux loss cannot cross the shard_map program)"
         )
     extra["attn_impl"] = "ring"
-    config.model.model_extra_configs = extra
+    return config.evolve(model=dict(model_extra_configs=extra))
 
 
 @register_trainer
 class SequenceParallelSFTTrainer(SFTTrainer):
     def __init__(self, config: TRLConfig, **kwargs):
-        validate_sequence_parallel_config(config, type(self).__name__)
+        config = validate_sequence_parallel_config(config, type(self).__name__)
         if config.tokenizer.padding_side != "right":
             # the ring position rule derives positions from the shard
             # offset, which is only correct for right-padded batches

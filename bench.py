@@ -1,25 +1,49 @@
-"""Headline benchmark: end-to-end PPO samples/sec/chip, GPT-2-small scale.
+"""Headline benchmark: end-to-end PPO throughput at GPT-2-small's REAL shape.
 
-Measures one full PPO cycle — experience collection (jitted autoregressive
-generation + host reward + jitted logprob/value/ref precompute) followed by
-`ppo_epochs` optimization passes over the rollout store — and reports
-rollout samples per second per chip. This is the reference's
-AcceleratePPOTrainer hot path (make_experience + learn inner loop,
-SURVEY.md §3.2-3.3) on the default PPO hyperparameters
-(num_rollouts=128, chunk_size=128, ppo_epochs=4, max_new_tokens=40).
+Measures full PPO cycles — experience collection (jitted autoregressive
+generation + host reward + jitted fused policy/value/reference scoring)
+followed by `ppo_epochs` optimization passes over the rollout store — i.e.
+the reference's AcceleratePPOTrainer hot path (make_experience + learn
+inner loop, SURVEY.md §3.2-3.3).
 
-The reference publishes no throughput numbers (SURVEY.md §6). The
-`vs_baseline` ratio therefore normalizes against the north-star target in
-BASELINE.json — 3x an estimated 1xA100 Accelerate-PPO rate of ~12
-samples/s for this exact config (128 rollouts x 40 generated tokens plus 4
-PPO epochs in a ~10s iteration is typical for torch gpt2-small PPO on one
-A100) — i.e. vs_baseline >= 1.0 means the >=3x-per-chip goal is met.
+Workload = the reference's DEFAULT PPO configuration
+(/root/reference/trlx/data/default_configs.py:17-59), at full fidelity:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+- model: random-init GPT-2-small — d_model 768, 12 layers, 12 heads,
+  **vocab 50,257**, tied embeddings → 124.4M params (bf16 activations);
+- train.seq_length 1024, batch_size 32, num_rollouts = chunk_size = 128,
+  ppo_epochs 4, num_layers_unfrozen 2, max_new_tokens 40, pure sampling
+  (top_k=0, top_p=1.0);
+- prompts: 64 tokens — sentiment-task scale (IMDB review prefixes in
+  examples/ppo_sentiments.py run tens of tokens, far below the 984-token
+  `max_prompt_length` cap that trlx.py:101 derives from seq_length);
+- attention: Pallas flash kernel (`attn_impl="flash"`) in the scoring and
+  training forwards; the fused cross-entropy kernel streams the 50k vocab
+  (trlx_tpu/ops/fused_ce.py) in every logprob/CE computation. A parity
+  check (Pallas vs XLA, both kernels, at bench shapes) runs on-chip
+  before timing and its max deviation is printed to stderr.
+
+The tokenizer is the builtin byte tokenizer (no network egress in this
+environment) with the model's vocab padded to GPT-2's 50,257 via
+`model_extra_configs.vocab_size`, so softmax/CE/embedding costs match the
+real model exactly; sampled ids ≥ 259 simply decode to nothing, which only
+affects the (host-side, O(chars)) toy reward — not the measured compute.
+
+`vs_baseline` normalizes against the north star in BASELINE.json: 3x an
+estimated 1xA100 torch Accelerate-PPO rate of ~12 samples/s **for this
+workload** (128 rollouts of 64+40 tokens, 4 PPO epochs at batch 32 on
+gpt2-small is a ~10s iteration for torch PPO on one A100).
+vs_baseline >= 1.0 means the >=3x-per-chip goal is met.
+
+Timing window: >= 5 timed cycles AND >= 10s (after a full warmup cycle
+that triggers all compiles). On the axon relay backend block_until_ready
+does not block, so every cycle ends with a host copy of the loss.
+
+Prints ONE JSON line with: metric/value/unit/vs_baseline plus
+tokens_per_sec_per_chip and mfu_estimate.
 """
 
 import json
-import os
 import sys
 import time
 
@@ -28,39 +52,66 @@ import numpy as np
 ESTIMATED_A100_SAMPLES_PER_SEC = 12.0
 NORTH_STAR_MULTIPLE = 3.0
 
+# bf16 peak FLOP/s per chip by device kind (dense; no sparsity).
+PEAK_FLOPS = [
+    ("v5 lite", 197e12),  # TPU v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6", 918e12),  # trillium
+]
+
+N_PROMPT = 64
+
+
+def chip_peak_flops() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in PEAK_FLOPS:
+        if tag in kind:
+            return peak
+    return 197e12  # unknown TPU: assume v5e-class
+
 
 def build_trainer(smoke: bool = False):
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
     from trlx_tpu.trainer.ppo_trainer import PPOTrainer
 
-    model = "random:gpt2-tiny" if smoke else "random:gpt2-small"
-    num_rollouts = 16 if smoke else 128
-    max_new = 8 if smoke else 40
-
-    config = default_ppo_config().evolve(
-        model=dict(model_path=model, num_layers_unfrozen=2),
-        tokenizer=dict(tokenizer_path="byte"),
-        train=dict(seq_length=128, batch_size=32 if not smoke else 8, tracker=None,
-                   fuse_inner_epoch=True, fuse_all_inner_epochs=True),
-        method=dict(
-            num_rollouts=num_rollouts,
-            chunk_size=num_rollouts,
-            gen_kwargs=dict(max_new_tokens=max_new, top_k=0, top_p=1.0, do_sample=True),
-        ),
+    config = default_ppo_config()
+    if smoke:
+        config = config.evolve(
+            model=dict(model_path="random:gpt2-tiny"),
+            train=dict(seq_length=128, batch_size=8),
+            method=dict(num_rollouts=16, chunk_size=16,
+                        gen_kwargs=dict(max_new_tokens=8)),
+        )
+    config = config.evolve(
+        # Full GPT-2 vocab + the Pallas flash-attention hot path; everything
+        # else stays at the reference defaults (seq_length 1024, batch 32,
+        # 128 rollouts, 4 ppo epochs, 40 new tokens, 2 unfrozen layers).
+        model=dict(model_extra_configs=dict(
+            vocab_size=50257 if not smoke else 1024, attn_impl="flash",
+        )),
+        train=dict(tracker=None, fuse_inner_epoch=True, fuse_all_inner_epochs=True),
     )
 
     def reward_fn(samples, prompts, outputs, **kwargs):
-        # Deterministic host-side reward (letter-frequency proxy): cheap and
-        # offline, exercising the same host<->device choreography as a real
-        # reward model without requiring checkpoint downloads.
+        # Deterministic host-side reward: cheap and offline, exercising the
+        # same host<->device choreography as a real reward model.
         return [float(out.count("e") - out.count("z")) for out in outputs]
 
     trainer = PPOTrainer(config, reward_fn=reward_fn)
 
     rng = np.random.default_rng(0)
-    prompts = ["".join(chr(c) for c in rng.integers(97, 123, size=24)) for _ in range(256)]
-    pipeline = PromptPipeline(prompts, max_prompt_length=24, tokenizer=trainer.tokenizer)
+    n_prompt = N_PROMPT if not smoke else 16
+    prompts = [
+        "".join(chr(c) for c in rng.integers(97, 123, size=n_prompt))
+        for _ in range(256)
+    ]
+    pipeline = PromptPipeline(prompts, max_prompt_length=n_prompt,
+                              tokenizer=trainer.tokenizer)
     trainer.add_prompt_pipeline(pipeline)
     return trainer, config
 
@@ -83,7 +134,6 @@ def run_cycle(trainer, config):
         for epoch in range(config.method.ppo_epochs):
             loader = trainer.create_train_dataloader(seed_offset=epoch)
             if config.train.fuse_inner_epoch and trainer.num_mb == 1:
-                # fused inner epoch: one lax.scan dispatch per epoch
                 stats, _ = trainer.train_inner_epoch_fused(loader)
             else:
                 for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
@@ -93,42 +143,152 @@ def run_cycle(trainer, config):
     return float(np.asarray(stats["losses"]["total_loss"]))
 
 
+def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
+                    unfrozen) -> dict:
+    """Itemized FLOP estimate for one PPO cycle (documented approximations;
+    used only for the MFU estimate, never for vs_baseline).
+
+    Per-token forward cost at context c:
+      L*(8 d^2 + 4 d d_ff)   block matmuls (qkvo 2*4d^2 + mlp 2*2*d*d_ff)
+      + L*4*c*d              attention scores + prob@V
+      + 2 d V                lm_head logits
+    Backward stops at the freeze split (grads are taken w.r.t. the
+    trainable partition only, base_trainer.py grad_fn; XLA prunes below):
+    dX through the lm_head matmul + the `unfrozen` top blocks, plus dW
+    over those same blocks (the tied embedding is frozen, so the head
+    contributes dX but no dW). Generation decode counts the lm_head every
+    step and prefill counts it on all prompt positions (that is what the
+    engine computes)."""
+    d, L, dff, V = (model_cfg.d_model, model_cfg.n_layers,
+                    model_cfg.d_ff, model_cfg.vocab_size)
+    T = n_prompt + n_new
+    blk = 8 * d * d + 4 * d * dff
+    head = 2 * d * V
+
+    def fwd(tokens, avg_ctx, layers=L, with_head=True):
+        return tokens * (layers * blk + layers * 4 * avg_ctx * d
+                         + (head if with_head else 0))
+
+    # generation: prefill the prompt, then n_new cached decode steps
+    gen = fwd(n_prompt, n_prompt / 2) + fwd(n_new, n_prompt + n_new / 2)
+    # scoring: full policy+value fwd, plus the in-graph frozen-reference
+    # branch re-running the top `unfrozen` blocks + lm_head
+    score = fwd(T, T / 2) + fwd(T, T / 2, layers=unfrozen)
+    # one train step: fwd (full) + dX (head matmul + unfrozen blocks) +
+    # dW (unfrozen blocks only — backprop is pruned below the freeze split)
+    train = (fwd(T, T / 2, with_head=True)
+             + fwd(T, T / 2, layers=unfrozen, with_head=True)
+             + fwd(T, T / 2, layers=unfrozen, with_head=False))
+    per_sample = gen + score + ppo_epochs * train
+    return {
+        "generate": n_rollouts * gen,
+        "score": n_rollouts * score,
+        "train": n_rollouts * ppo_epochs * train,
+        "total": n_rollouts * per_sample,
+    }
+
+
+def pallas_parity_check() -> dict:
+    """Prove the Pallas kernels run on THIS chip and match the XLA paths at
+    bench-like shapes. Returns max abs deviations."""
+    import jax
+    import jax.numpy as jnp
+
+    from trlx_tpu.ops.attention import _flash_fwd_pallas, blockwise_attention
+    from trlx_tpu.ops.fused_ce import _logprobs_pallas, _logprobs_xla
+
+    key = jax.random.PRNGKey(0)
+    b, t, nh, hd = 4, 1024, 12, 64
+    q = jax.random.normal(key, (b, t, nh, hd), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), q.shape, jnp.bfloat16)
+    mask = jnp.ones((b, t), jnp.int32).at[:, -100:].set(0)
+    o_pallas = np.asarray(jax.jit(
+        lambda q, k, v, m: _flash_fwd_pallas(q, k, v, m, True, 128, 128)
+    )(q, k, v, mask)).astype(np.float32)
+    o_xla = np.asarray(jax.jit(
+        lambda q, k, v, m: blockwise_attention(q, k, v, m)
+    )(q, k, v, mask)).astype(np.float32)
+    flash_dev = float(np.abs(o_pallas - o_xla).max())
+
+    n, V = 2048, 50257
+    logits = jax.random.normal(jax.random.fold_in(key, 3), (n, V), jnp.bfloat16) * 3
+    labels = jax.random.randint(jax.random.fold_in(key, 4), (n,), 0, V)
+    lp_pallas = np.asarray(jax.jit(lambda l, y: _logprobs_pallas(l, y)[0])(logits, labels))
+    lp_xla = np.asarray(jax.jit(
+        lambda l, y: _logprobs_xla(l.astype(jnp.float32), y)[0]
+    )(logits, labels))
+    ce_dev = float(np.abs(lp_pallas - lp_xla).max())
+
+    assert flash_dev < 5e-2, f"flash-attention parity failed on chip: {flash_dev}"
+    assert ce_dev < 1e-3, f"fused-CE parity failed on chip: {ce_dev}"
+    return {"flash_max_dev": flash_dev, "fused_ce_max_dev": ce_dev}
+
+
 def main():
     smoke = "--smoke" in sys.argv
     t0 = time.time()
 
     import jax
 
-    try:  # persistent XLA compile cache: repeat runs skip the ~2min warmup compile
+    try:  # persistent XLA compile cache: repeat runs skip the warmup compile
         jax.config.update("jax_compilation_cache_dir", "/tmp/trlx_tpu_xla_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
 
-    trainer, config = build_trainer(smoke)
+    if jax.default_backend() == "tpu" and not smoke:
+        parity = pallas_parity_check()
+        sys.stderr.write(
+            f"[bench] on-chip Pallas parity: flash max|dev| "
+            f"{parity['flash_max_dev']:.2e} (bf16, seq 1024), fused-CE "
+            f"max|dev| {parity['fused_ce_max_dev']:.2e} (vocab 50257)\n"
+        )
 
+    trainer, config = build_trainer(smoke)
     n_chips = max(jax.device_count(), 1)
 
     run_cycle(trainer, config)  # warmup: compiles generate/score/train steps
     warm = time.time()
 
-    cycles = 1 if smoke else 2
-    for _ in range(cycles):
+    min_cycles, min_seconds = (1, 0.0) if smoke else (5, 10.0)
+    cycles = 0
+    while cycles < min_cycles or (time.time() - warm) < min_seconds:
         run_cycle(trainer, config)
+        cycles += 1
     elapsed = time.time() - warm
 
+    n_new = config.method.gen_kwargs["max_new_tokens"]
+    n_prompt = N_PROMPT if not smoke else 16
     samples = cycles * config.method.num_rollouts
+    tokens = samples * (n_prompt + n_new)
     sps_chip = samples / elapsed / n_chips
+    tps_chip = tokens / elapsed / n_chips
+
+    flops = flops_per_cycle(
+        trainer.model_cfg, n_prompt, n_new, config.method.num_rollouts,
+        config.method.ppo_epochs, config.model.num_layers_unfrozen,
+    )
+    mfu = flops["total"] * cycles / elapsed / n_chips / chip_peak_flops()
+
     baseline = ESTIMATED_A100_SAMPLES_PER_SEC * NORTH_STAR_MULTIPLE
     print(json.dumps({
         "metric": "ppo_samples_per_sec_per_chip",
         "value": round(sps_chip, 3),
         "unit": "samples/s/chip",
         "vs_baseline": round(sps_chip / baseline, 3),
+        "tokens_per_sec_per_chip": round(tps_chip, 1),
+        "mfu_estimate": round(mfu, 4),
     }))
     sys.stderr.write(
-        f"[bench] setup+warmup {warm - t0:.1f}s, {cycles} timed cycles in "
-        f"{elapsed:.1f}s on {n_chips} chip(s) ({jax.devices()[0].platform})\n"
+        f"[bench] {config.model.model_path} vocab {trainer.model_cfg.vocab_size}, prompts "
+        f"{n_prompt} + {n_new} new tokens, batch {config.train.batch_size}, "
+        f"{config.method.num_rollouts} rollouts x {config.method.ppo_epochs} "
+        f"ppo epochs; setup+warmup {warm - t0:.1f}s, {cycles} timed cycles "
+        f"in {elapsed:.1f}s on {n_chips} chip(s) "
+        f"({jax.devices()[0].device_kind}); est. FLOPs/cycle "
+        f"{flops['total'] / 1e12:.2f}T (gen {flops['generate'] / 1e12:.2f} / "
+        f"score {flops['score'] / 1e12:.2f} / train {flops['train'] / 1e12:.2f})\n"
     )
 
 

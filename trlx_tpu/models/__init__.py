@@ -85,6 +85,11 @@ def resolve_transformer_config(model_config, vocab_size: int):
         dtype_overrides.update(lora_overrides_from_peft_config(peft_config))
     if path.startswith("random:"):
         preset = path[len("random:"):]
+        # model_extra_configs.vocab_size overrides the tokenizer-derived
+        # vocab for presets (e.g. benchmarking the real 50257-token softmax
+        # with a byte tokenizer); HF checkpoints keep their own vocab and
+        # receive the key as a plain config override below.
+        vocab_size = extra.pop("vocab_size", vocab_size)
         if preset in SEQ2SEQ_PRESETS and not seq2seq:
             # model_arch_type is the single source of truth the trainers
             # dispatch on; a silent promotion here would desync them.

@@ -208,35 +208,46 @@ class MeshRuntime:
         if devices is None:
             initialize_distributed()
         if getattr(parallel_config, "pipeline", 1) not in (1, None):
-            # ("data", "pipe") mesh for GPipe trainers; fsdp/tensor compose
-            # with PP only through the stacked-param layout those trainers
-            # own, so they must stay 1 here.
+            # ("data", "pipe", "fsdp", "tensor") mesh for GPipe trainers:
+            # data/pipe are the manual shard_map axes; fsdp/tensor stay
+            # GSPMD-auto inside the pipeline program (TP x PP / ZeRO x PP,
+            # the reference's megatron_65b.yaml:49-50 TP=8 x PP=4 layout).
             if (
-                parallel_config.fsdp != 1
-                or parallel_config.tensor != 1
-                or parallel_config.sequence != 1
+                parallel_config.sequence != 1
                 or getattr(parallel_config, "dcn_data", 1) != 1
             ):
                 raise NotImplementedError(
-                    "parallel.pipeline composes with the data axis only "
-                    "(DP x PP); set fsdp/tensor/sequence/dcn_data to 1"
+                    "parallel.pipeline composes with data/fsdp/tensor; set "
+                    "sequence/dcn_data to 1"
                 )
             from trlx_tpu.parallel.pipeline import make_pipe_mesh
 
             devices = devices if devices is not None else jax.devices()
             pipe = parallel_config.pipeline
+            tensor = parallel_config.tensor
+            fsdp = parallel_config.fsdp
+            if tensor < 1 or fsdp < 1 or pipe < 1:
+                # -1 ("rest of the devices") is a data-axis-only idiom on
+                # pipeline meshes; a negative size here would slip through
+                # the coverage check by sign cancellation
+                raise ValueError(
+                    f"parallel.pipeline/fsdp/tensor must be >= 1 on a "
+                    f"pipeline mesh (got pipeline={pipe}, fsdp={fsdp}, "
+                    f"tensor={tensor}); only parallel.data may be -1"
+                )
             data = parallel_config.data
             if data == -1:
-                data = len(devices) // pipe
-            if data * pipe != len(devices):
+                data = len(devices) // (pipe * tensor * fsdp)
+            if data * pipe * tensor * fsdp != len(devices):
                 # loud, like _resolve_axis_sizes — silently idling devices
                 # is worse than making the user restrict `devices`
                 raise ValueError(
-                    f"data={data} x pipeline={pipe} covers {data * pipe} "
+                    f"data={data} x pipeline={pipe} x fsdp={fsdp} x "
+                    f"tensor={tensor} covers {data * pipe * tensor * fsdp} "
                     f"devices but {len(devices)} are available; adjust "
-                    "parallel.data/pipeline or pass a device subset"
+                    "parallel.* or pass a device subset"
                 )
-            mesh = make_pipe_mesh(pipe, devices=devices)
+            mesh = make_pipe_mesh(pipe, devices=devices, tensor=tensor, fsdp=fsdp)
             logger.info(
                 f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
             )

@@ -58,21 +58,82 @@ def _varying(x, axis_name: str):
         return x
 
 
-def make_pipe_mesh(n_stages: int, devices=None) -> Mesh:
+def make_pipe_mesh(n_stages: int, devices=None, tensor: int = 1, fsdp: int = 1) -> Mesh:
+    """("data", "pipe", "fsdp", "tensor") mesh for pipelined trainers.
+
+    "data" and "pipe" are the MANUAL axes of the GPipe shard_map program;
+    "fsdp"/"tensor" stay under GSPMD (auto) control so tensor parallelism
+    and ZeRO param sharding compose with the pipeline without hand-written
+    collectives — XLA inserts the Megatron-style all-reduces from the
+    stacked params' PartitionSpecs (the reference instead nests Apex
+    Column/RowParallelLinear modules inside its pipeline engine,
+    modeling_nemo_ppo.py:93-121, 713-731). "tensor" is innermost so its
+    per-matmul collectives ride the fastest ICI links."""
     devices = devices if devices is not None else jax.devices()
-    if len(devices) % n_stages != 0:
-        raise ValueError(f"{len(devices)} devices not divisible into {n_stages} stages")
+    if len(devices) % (n_stages * tensor * fsdp) != 0:
+        raise ValueError(
+            f"{len(devices)} devices not divisible into {n_stages} stages x "
+            f"fsdp={fsdp} x tensor={tensor}"
+        )
     # Any extra devices form a leading data axis for DP x PP hybrids. Use
     # mesh_utils placement so consecutive pipe stages land on neighboring
     # ICI links (the per-tick ppermute hop), mirroring make_mesh.
-    sizes = (len(devices) // n_stages, n_stages)
+    sizes = (len(devices) // (n_stages * tensor * fsdp), n_stages, fsdp, tensor)
     try:
         from jax.experimental import mesh_utils
 
         arr = mesh_utils.create_device_mesh(sizes, devices=devices)
     except Exception:  # CPU/host meshes without topology info
         arr = np.asarray(devices).reshape(sizes)
-    return Mesh(arr, ("data", PIPE_AXIS))
+    return Mesh(arr, ("data", PIPE_AXIS, "fsdp", "tensor"))
+
+
+def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map manual over ("data", "pipe"); any other mesh axes
+    (fsdp/tensor) stay auto so GSPMD shards the math inside the body.
+
+    When every non-manual axis has size 1 there is nothing to
+    auto-partition, so the plain (full-manual) shard_map is used — this
+    also sidesteps an XLA:CPU crash compiling bf16 collectives under
+    partially-manual meshes (f32 and full-manual bf16 both compile;
+    observed on jax 0.9 / 8-device host platform). Consequence: TP/FSDP x
+    PP programs on the CPU test mesh should pin dtype=float32 (the
+    pipelined parity tests do anyway, for exact comparisons)."""
+    manual = {"data", PIPE_AXIS} & set(mesh.axis_names)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if all(sizes[a] == 1 for a in mesh.axis_names if a not in manual):
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=manual,
+        )
+    except TypeError:  # older jax: auto= complement instead of axis_names=
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            auto=frozenset(set(mesh.axis_names) - manual),
+        )
+
+
+def stacked_param_shardings(mesh: Mesh, stacked, n_lead: int, rules=None):
+    """NamedShardings for a stacked block pytree: dim 0 over "pipe", the
+    other leading (virtual-stage / layers-per-stage) dims replicated, and
+    the matrix dims per the TP/FSDP rule table — the stacked-layout
+    analogue of infer_param_shardings. On a mesh without fsdp/tensor axes
+    the trailing spec degrades to replicated."""
+    from jax.sharding import NamedSharding
+
+    from trlx_tpu.parallel.sharding import GPT_RULES, param_path
+
+    rules = rules if rules is not None else GPT_RULES
+
+    def _spec(keypath, leaf):
+        shape = np.shape(leaf)
+        trailing = rules.spec_for(param_path(keypath), shape[n_lead:], mesh)
+        trailing = tuple(trailing) + (None,) * (len(shape) - n_lead - len(tuple(trailing)))
+        return NamedSharding(mesh, P(PIPE_AXIS, *(None,) * (n_lead - 1), *trailing))
+
+    return jax.tree_util.tree_map_with_path(_spec, stacked)
 
 
 def unstack_block_params(stacked: Dict, rest: Dict, n_layers: int) -> Dict:
@@ -340,11 +401,13 @@ def make_gpipe_forward_stacked(
     # Batch sharded over the mesh's "data" axis (DP x PP hybrid: each
     # data slice runs its own pipeline over the shared stage params);
     # shard_map's transpose inserts the data-axis grad psum for the
-    # replicated params automatically.
+    # replicated params automatically. fsdp/tensor axes (if the mesh has
+    # them) stay auto: GSPMD shards the per-stage matmuls from the stacked
+    # params' PartitionSpecs and inserts the TP collectives.
     out_spec = (P("data"), P("data")) if with_hidden else P("data")
-    return shard_map(
+    return partial_shard_map(
         inner,
-        mesh=mesh,
+        mesh,
         in_specs=(P(PIPE_AXIS), P(), P("data"), P("data")),
         out_specs=out_spec,
     )

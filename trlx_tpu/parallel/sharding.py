@@ -56,12 +56,15 @@ class ShardingRules:
                 if len(spec) > len(shape):
                     spec = spec[len(spec) - len(shape):]
                 full = (None,) * (len(shape) - len(spec)) + tuple(spec)
-                # Drop shardings that don't divide the dim (e.g. tiny test models).
+                # Drop shardings that don't divide the dim (e.g. tiny test
+                # models) or whose axis the mesh doesn't have (e.g. "fsdp"
+                # on a ("data","pipe","tensor") pipeline mesh).
                 checked = []
                 for dim, ax in zip(shape, full):
+                    axes = ax if isinstance(ax, tuple) else (ax,)
                     ok = ax is not None and all(
-                        dim % axis_sizes.get(a, 1) == 0 for a in (ax if isinstance(ax, tuple) else (ax,))
-                    ) and np.prod([axis_sizes.get(a, 1) for a in (ax if isinstance(ax, tuple) else (ax,))]) <= dim
+                        a in axis_sizes and dim % axis_sizes[a] == 0 for a in axes
+                    ) and np.prod([axis_sizes.get(a, 1) for a in axes]) <= dim
                     checked.append(ax if ok else None)
                 return P(*checked)
         return self._fallback(shape, axis_sizes)

@@ -523,6 +523,13 @@ class TPUTrainer(BaseRLTrainer):
                 results = res or results
                 if done:
                     return results
+                # Deferred callback replay is exactly equivalent to the
+                # unfused interleaving: mean_kl is computed once per
+                # experience collection (as in the reference,
+                # accelerate_ppo_trainer.py:506-507) and kl_ctl.value is
+                # only read at the NEXT collection, so n updates with the
+                # same mean_kl commute with the epochs
+                # (tests/test_kl_cadence.py pins this).
                 for _ in range(self.n_inner_epochs):
                     self.post_backward_callback()
                 self.post_epoch_callback()

@@ -64,6 +64,9 @@ class PipelinedCausalMixin:
     # ------------------------------------------------------------------
 
     def place_params(self, params) -> Dict:
+        from trlx_tpu.parallel import infer_param_shardings
+        from trlx_tpu.parallel.pipeline import stacked_param_shardings
+
         runtime: PipeMeshRuntime = self.runtime
         assert isinstance(runtime, PipeMeshRuntime)
         n_stages = runtime.n_stages
@@ -73,18 +76,21 @@ class PipelinedCausalMixin:
         stacked, rest = stack_block_params_interleaved(
             params["lm"], cfg.n_layers, n_stages, self._n_virtual
         )
+        # dim 0 over "pipe"; matrix dims over the mesh's fsdp/tensor axes
+        # per the TP rule table (GSPMD-auto inside the GPipe shard_map) —
+        # a 65B-class stage no longer has to fit one chip.
+        n_lead = 2 if self._n_virtual == 1 else 3
+        stacked_sh = stacked_param_shardings(runtime.mesh, stacked, n_lead)
         placed = {
-            "lm_stacked": jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, runtime.pipe_sharding), stacked
-            ),
+            "lm_stacked": jax.tree_util.tree_map(jax.device_put, stacked, stacked_sh),
             "lm_rest": jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, runtime.replicated), rest
+                jax.device_put, rest, infer_param_shardings(runtime.mesh, rest)
             ),
         }
         for k, v in params.items():
             if k != "lm":
                 placed[k] = jax.tree_util.tree_map(
-                    lambda x: jax.device_put(x, runtime.replicated), v
+                    jax.device_put, v, infer_param_shardings(runtime.mesh, v)
                 )
         n_stage_params = sum(
             int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(stacked)

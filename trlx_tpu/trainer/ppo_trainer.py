@@ -497,17 +497,24 @@ class PPOTrainer(TPUTrainer):
         # seed moves with iter_count so each inner epoch reshuffles (the
         # reference's torch DataLoader draws from global RNG each epoch);
         # seed_offset distinguishes epochs created up front by the fused path.
-        # Static pad widths from the config keep batch shapes identical
-        # across rollout collections (no train-step recompiles). Queries
-        # are truncated with gen_kwargs' budget (trlx.py max_prompt_length);
-        # responses/stats with the experience budget, which may differ.
+        # Pad widths are BUCKETED: the store's observed query maximum
+        # rounds up to a 64-token bucket (capped by the config budget), so
+        # batch shapes stay identical across rollout collections while
+        # short prompts never pay the worst-case seq_length in train-step
+        # FLOPs — padding a 64-token prompt to the 984-token budget made
+        # every optimizer step ~10x more expensive. A recompile happens
+        # only if a later collection crosses a bucket boundary.
+        # Responses/stats use the experience budget (tight already).
         exp_kwargs = self.generate_experience_kwargs or self.generate_kwargs
         exp_max_new = int(exp_kwargs.get("max_new_tokens", 40))
         eval_max_new = int(self.generate_kwargs.get("max_new_tokens", 40))
+        budget_q = self.config.train.seq_length - eval_max_new
+        obs_q = max((len(e.query_tensor) for e in self.store.history), default=0)
+        bucket_q = min(budget_q, -(-obs_q // 64) * 64)
         return self.store.create_loader(
             self.config.train.batch_size, shuffle=True, drop_last=drop_last,
             seed=self.config.train.seed + self.iter_count + seed_offset,
-            max_query_len=self.config.train.seq_length - eval_max_new,
+            max_query_len=bucket_q,
             max_response_len=exp_max_new + (1 if self.seq2seq else 0),
             max_stat_len=exp_max_new,
         )

@@ -164,16 +164,33 @@ def stack_block_params(params: Dict, n_layers: int, n_stages: int) -> Tuple[Dict
     return stacked, rest
 
 
-def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions, attn_mask):
+def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
+                       attn_mask, layer_offset=0, freeze_split: int = 0):
     """Sequentially apply this stage's layers via lax.scan over the stacked
-    param dim (static per-layer graph, compiled once)."""
+    param dim (static per-layer graph, compiled once).
+
+    `freeze_split` > 0 freezes the bottom `freeze_split` GLOBAL layers
+    (reference freeze_bottom_causal_layers under PP,
+    modeling_nemo_ppo.py:497-536): each frozen layer's output passes
+    through `stop_gradient`, so no cotangent reaches its params or
+    anything below it. `layer_offset` (static or traced — the stage/chunk
+    index is an axis_index) maps the scan slot to the global layer."""
     block = Block(cfg)
+    n_local = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
 
-    def body(h, lp):
-        h, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
-        return h, None
+    def body(h, xs):
+        lp, i = xs
+        h_out, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
+        if freeze_split > 0:
+            frozen = (layer_offset + i) < freeze_split
+            # value-level select: d/dh is scaled by the 0/1 indicator, so
+            # frozen layers contribute no param grads and cut the backward
+            # below them; the update mask (pipelined_mixin) additionally
+            # shields them from optimizer side effects like weight decay
+            h_out = jnp.where(frozen, jax.lax.stop_gradient(h_out), h_out)
+        return h_out, None
 
-    h, _ = jax.lax.scan(body, h, layer_params)
+    h, _ = jax.lax.scan(body, h, (layer_params, jnp.arange(n_local)))
     return h
 
 
@@ -184,6 +201,7 @@ def gpipe_blocks(
     attn_mask: jnp.ndarray,  # [B, t]
     n_microbatches: int,
     axis_name: str = PIPE_AXIS,
+    freeze_split: int = 0,
 ) -> jnp.ndarray:
     """Run the block stack as a GPipe pipeline. Must be called inside
     shard_map with `axis_name` bound. Returns [B, t, d] (valid on every
@@ -199,12 +217,17 @@ def gpipe_blocks(
     h_mbs = h.reshape(M, mb, t, d)
     mask_mbs = attn_mask.reshape(M, mb, t)
 
+    lps = jax.tree_util.tree_leaves(my_layers)[0].shape[0]
+
     def stage(x, mask):
         positions = position_ids(mask)
         # shared bias policy with TransformerLM (None => fused kernel
         # builds causal+padding structure blockwise, no O(t^2) tensor)
         bias = train_bias(cfg, mask)
-        return _apply_layer_stack(cfg, my_layers, x, bias, positions, mask)
+        return _apply_layer_stack(
+            cfg, my_layers, x, bias, positions, mask,
+            layer_offset=idx * lps, freeze_split=freeze_split,
+        )
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
 
@@ -285,6 +308,7 @@ def interleaved_blocks(
     n_microbatches: int,
     n_virtual: int,
     axis_name: str = PIPE_AXIS,
+    freeze_split: int = 0,
 ) -> jnp.ndarray:
     """Interleaved (virtual-stage) pipeline schedule: each device holds
     `n_virtual` layer chunks placed round-robin, and every microbatch loops
@@ -315,10 +339,17 @@ def interleaved_blocks(
     h_mbs = h.reshape(M, mb, t, d)
     mask_mbs = attn_mask.reshape(M, mb, t)
 
-    def stage(chunk_params, x, mask):
+    lps = jax.tree_util.tree_leaves(my_chunks)[0].shape[1]
+
+    def stage(chunk_params, x, mask, loop):
         positions = position_ids(mask)
         bias = train_bias(cfg, mask)
-        return _apply_layer_stack(cfg, chunk_params, x, bias, positions, mask)
+        # chunk `loop` on device idx covers global layers starting at
+        # (loop*S + idx) * lps (the round-robin placement)
+        return _apply_layer_stack(
+            cfg, chunk_params, x, bias, positions, mask,
+            layer_offset=(loop * S + idx) * lps, freeze_split=freeze_split,
+        )
 
     ring_perm = [(s, (s + 1) % S) for s in range(S)]
     span = S * v
@@ -346,7 +377,7 @@ def interleaved_blocks(
             lambda p: jax.lax.dynamic_index_in_dim(p, loop_in, 0, keepdims=False),
             my_chunks,
         )
-        y = stage(chunk, x, mask)
+        y = stage(chunk, x, mask, loop_in)
 
         bank_now = valid & (idx == S - 1) & (loop == v - 1)
         banked = jax.lax.dynamic_update_index_in_dim(out, y, m_in, 0)
@@ -373,6 +404,7 @@ def make_gpipe_forward_stacked(
     n_microbatches: int,
     with_hidden: bool = False,
     n_virtual: int = 1,
+    freeze_split: int = 0,
 ) -> Callable:
     """Build fn(stacked, rest, tokens, attn_mask) -> logits (or
     (logits, h_final) with with_hidden) where `stacked` is the
@@ -392,9 +424,11 @@ def make_gpipe_forward_stacked(
     def inner(stacked, rest, tokens, attn_mask):
         h = embed(rest, tokens, attn_mask)
         if n_virtual > 1:
-            h = interleaved_blocks(cfg, stacked, h, attn_mask, n_microbatches, n_virtual)
+            h = interleaved_blocks(cfg, stacked, h, attn_mask, n_microbatches,
+                                   n_virtual, freeze_split=freeze_split)
         else:
-            h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches)
+            h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches,
+                             freeze_split=freeze_split)
         logits, h_final = unembed(rest, h)
         return (logits, h_final) if with_hidden else logits
 

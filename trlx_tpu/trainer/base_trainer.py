@@ -206,6 +206,15 @@ class TPUTrainer(BaseRLTrainer):
     def make_trainable_mask(self, params) -> Dict:
         return trainable_mask(params, self.model_cfg, self.config.model.num_layers_unfrozen)
 
+    def make_update_mask(self) -> Optional[Dict]:
+        """Optional {flat_key: 0/1 array} multiplied onto optimizer UPDATES
+        for train_params leaves that are only partially trainable (a freeze
+        boundary cutting through a stacked-layer leaf — pipelined trainers).
+        Grads through such layers are already cut in-graph; this stops
+        grad-independent optimizer terms (AdamW weight decay) from moving
+        the frozen slices. None = no masking (every plain layout)."""
+        return None
+
     def post_backward_callback(self):
         pass
 
@@ -307,6 +316,15 @@ class TPUTrainer(BaseRLTrainer):
     def _build_steps(self):
         loss_fn = self.make_loss_fn()
         optimizer = self.optimizer
+        update_mask = self.make_update_mask()
+
+        def masked(updates):
+            if update_mask is None:
+                return updates
+            return {
+                k: (u * update_mask[k] if k in update_mask else u)
+                for k, u in updates.items()
+            }
 
         # Pin param/opt-state outputs to their current (input) shardings:
         # otherwise the compiler may hand donated outputs back with
@@ -331,7 +349,7 @@ class TPUTrainer(BaseRLTrainer):
         def train_step(train_params, frozen_params, opt_state, batch):
             _, stats, grads = grad_fn(train_params, frozen_params, batch)
             updates, opt_state = optimizer.update(grads, opt_state, train_params)
-            train_params = optax.apply_updates(train_params, updates)
+            train_params = optax.apply_updates(train_params, masked(updates))
             train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state, stats
 
@@ -343,7 +361,7 @@ class TPUTrainer(BaseRLTrainer):
         def apply_step(train_params, opt_state, acc_grads):
             grads = jax.tree_util.tree_map(lambda g: g / self.num_mb, acc_grads)
             updates, opt_state = optimizer.update(grads, opt_state, train_params)
-            train_params = optax.apply_updates(train_params, updates)
+            train_params = optax.apply_updates(train_params, masked(updates))
             train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state
 
@@ -357,7 +375,7 @@ class TPUTrainer(BaseRLTrainer):
                 train_params, opt_state = carry
                 _, stats, grads = grad_fn(train_params, frozen_params, batch)
                 updates, opt_state = optimizer.update(grads, opt_state, train_params)
-                train_params = optax.apply_updates(train_params, updates)
+                train_params = optax.apply_updates(train_params, masked(updates))
                 return (train_params, opt_state), stats
 
             (train_params, opt_state), stats = jax.lax.scan(

@@ -43,13 +43,25 @@ class PipelinedCausalMixin:
             )
         if config.model.model_arch_type != "causal":
             raise NotImplementedError("pipeline parallelism covers causal models")
-        if config.model.num_layers_unfrozen != -1:
-            raise NotImplementedError(
-                "layer freezing under pipeline parallelism is not supported; "
-                "set model.num_layers_unfrozen = -1"
-            )
         if config.model.peft_config is not None:
-            raise NotImplementedError("LoRA under pipeline parallelism is not supported yet")
+            # LoRA composes with the pipeline (adapters are separate
+            # stacked leaves); prompt/prefix tuning does NOT — the GPipe
+            # embed path never prepends soft prompts and the mixin mask
+            # has no adapter-only branch for them.
+            from trlx_tpu.models.lora import lora_overrides_from_peft_config
+
+            overrides = lora_overrides_from_peft_config(config.model.peft_config)
+            if overrides.get("prompt_tokens", 0) or overrides.get("prefix_tokens", 0):
+                raise NotImplementedError(
+                    "prompt/prefix tuning under pipeline parallelism is not "
+                    "supported; use LoRA or a non-pipelined trainer"
+                )
+        extra = config.model.model_extra_configs or {}
+        if extra.get("prompt_tokens", 0) or extra.get("prefix_tokens", 0):
+            raise NotImplementedError(
+                "prompt/prefix tuning under pipeline parallelism is not "
+                "supported; use LoRA or a non-pipelined trainer"
+            )
         if (config.model.model_extra_configs or {}).get("moe_experts", 0) > 0:
             # the MoE load-balancing loss is sown via flax intermediates,
             # which don't cross the GPipe shard_map — training would
@@ -102,20 +114,93 @@ class PipelinedCausalMixin:
         return placed
 
     def make_trainable_mask(self, params) -> Dict:
-        # everything trainable under PP (num_layers_unfrozen == -1 is
-        # enforced); method trainers refine by calling this explicitly
-        # and masking their heads on top
-        return jax.tree_util.tree_map(lambda _: True, params)
+        """Reference freezing semantics on the stacked layout (plain
+        trainers: models/policy.py trainable_mask). Per-LEAF partitioning
+        handles everything except a freeze split that cuts through a
+        stacked [S, lps, ...] leaf — those leaves stay in the trainable
+        partition and are masked at layer granularity by (a) stop_gradient
+        inside the stage scan (pipeline.py _apply_layer_stack) and (b) the
+        per-layer optimizer update mask built in make_update_mask (AdamW's
+        weight decay would otherwise move frozen layers despite their
+        zero grads)."""
+        cfg = self.model_cfg
+        num_unfrozen = self.config.model.num_layers_unfrozen
+        lora = getattr(cfg, "lora_rank", 0) > 0
+        split = self.split  # resolve_split: 0 under LoRA / -1; n_layers when k=0
+
+        def _mask(path_keys, leaf):
+            parts = [str(getattr(k, "key", k)) for k in path_keys]
+            if parts[0] not in ("lm_stacked", "lm_rest"):
+                return True  # v_head / ilql_heads / auxiliary heads
+            if lora:
+                from trlx_tpu.models.lora import is_lora_path
+
+                return is_lora_path(path_keys)
+            if num_unfrozen == -1:
+                return True
+            if num_unfrozen == 0:
+                return False
+            if parts[0] == "lm_stacked":
+                # trainable iff ANY of the leaf's layers is above the
+                # split; the layer-level cut happens in-graph + via the
+                # update mask
+                return split < cfg.n_layers
+            # lm_rest: embeddings freeze, final norm / untied lm_head train
+            return parts[1] in ("ln_f", "lm_head")
+
+        return jax.tree_util.tree_map_with_path(_mask, params)
+
+    def make_update_mask(self):
+        """Per-layer 0/1 masks for stacked leaves that a freeze split cuts
+        through: GPipe layout [S, lps, ...] (layer = s*lps + j) or
+        interleaved [S, v, lps, ...] (layer = (l*S + s)*lps + j). Applied
+        to optimizer updates by the base trainer so frozen layers never
+        move (their grads are already zero via the in-graph stop_gradient;
+        this blocks AdamW's grad-independent weight decay)."""
+        cfg = self.model_cfg
+        num_unfrozen = self.config.model.num_layers_unfrozen
+        if getattr(cfg, "lora_rank", 0) > 0 or num_unfrozen in (-1, 0):
+            return None
+        split = self.split
+        if split <= 0 or split >= cfg.n_layers:
+            return None
+        S = self.runtime.n_stages
+        v = self._n_virtual
+        lps = cfg.n_layers // (S * v)
+        if v == 1:
+            layer = np.arange(S)[:, None] * lps + np.arange(lps)[None, :]
+            lead = 2
+        else:
+            s = np.arange(S)[:, None, None]
+            l = np.arange(v)[None, :, None]
+            j = np.arange(lps)[None, None, :]
+            layer = (l * S + s) * lps + j
+            lead = 3
+        base = (layer >= split).astype(np.float32)
+        mask = {}
+        for k, p in self.train_params.items():
+            if k[0] == "lm_stacked":
+                mask[k] = jnp.asarray(
+                    base.reshape(base.shape + (1,) * (np.ndim(p) - lead)),
+                    dtype=p.dtype,
+                )
+        return mask or None
 
     def make_stacked_lm_forward(self, with_hidden: bool = False):
         """fn(stacked, rest, tokens, mask) through the GPipe program, on a
         fresh TransformerLM module (definitions are pure)."""
         from trlx_tpu.models.transformer import TransformerLM
 
+        # LoRA's split-0 is a hydra concern (ref branch point), not a
+        # freeze boundary: adapters train in every layer, so the pipeline
+        # must not stop_gradient anything.
+        freeze_split = 0 if getattr(self.model_cfg, "lora_rank", 0) > 0 else (
+            self.split if self.config.model.num_layers_unfrozen not in (-1, 0) else 0
+        )
         return make_gpipe_forward_stacked(
             TransformerLM(self.model_cfg), self.model_cfg, self.runtime.mesh,
             n_microbatches=self._n_microbatches, with_hidden=with_hidden,
-            n_virtual=self._n_virtual,
+            n_virtual=self._n_virtual, freeze_split=freeze_split,
         )
 
     def standard_params(self) -> Dict:

@@ -21,10 +21,16 @@ design:
 
 Enable with:
     train.trainer: "PipelinedPPOTrainer"
-    parallel: {data: D, pipeline: S}
+    parallel: {data: D, pipeline: S}  (+ optional fsdp/tensor)
 
-num_layers_unfrozen must be -1 (everything trainable; the frozen
-reference is the full stacked copy, split 0).
+num_layers_unfrozen: any value. The frozen reference is always the full
+stacked copy taken at init (numerically identical to the hydra branch for
+any split, since everything below the split never trains); bottom-layer
+freezing cuts gradients inside the stage scan and masks optimizer
+updates at layer granularity (pipelined_mixin.make_update_mask). LoRA:
+adapter leaves are separate stacked leaves, so peft trains through the
+pipeline with per-leaf partitioning; the init-time copy doubles as the
+adapter-zero reference (B starts at 0).
 """
 
 from typing import Callable, Optional

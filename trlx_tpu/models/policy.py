@@ -44,7 +44,10 @@ class ValueBranch(nn.Module):
     n_branch_layers: int
 
     def setup(self):
-        self.blocks = [Block(self.cfg, name=f"block_{i}") for i in range(self.n_branch_layers)]
+        # honor cfg.remat_blocks like the trunk (this call site never
+        # passes the static use_prefix arg, so no static_argnums needed)
+        block_cls = nn.remat(Block) if self.cfg.remat_blocks else Block
+        self.blocks = [block_cls(self.cfg, name=f"block_{i}") for i in range(self.n_branch_layers)]
         self.ln_f = make_norm(self.cfg, "ln_f")
         self.v_head = MLPHead(1, self.cfg.dtype, self.cfg.param_dtype, name="v_head")
 

@@ -102,6 +102,14 @@ class TransformerConfig:
     prefix_tokens: int = 0
     dtype: Any = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     param_dtype: Any = jnp.float32
+    # Activation rematerialization per transformer block: the backward
+    # recomputes each block's internals instead of banking them, so
+    # activation memory drops from O(L · t · d_ff) to O(L · t · d) at
+    # ~1/3 extra FLOPs (the reference's NeMo activations_checkpoint_method
+    # toggles, modeling_nemo_ppo.py:788-836). Honored by TransformerLM's
+    # training forward AND the GPipe stage scan — under PP this is what
+    # keeps banked microbatch activations from scaling with d_ff.
+    remat_blocks: bool = False
     # "xla" (einsum softmax, short seqs), "flash" (Pallas fused kernel /
     # blockwise scan, trlx_tpu/ops/attention.py), "ring" (context-parallel
     # over the "sequence" mesh axis, trlx_tpu/ops/ring_attention.py —
@@ -507,7 +515,9 @@ class TransformerLM(nn.Module):
                 "soft_prompt", nn.initializers.normal(stddev=0.02),
                 (cfg.prompt_tokens, cfg.d_model), cfg.param_dtype,
             )
-        self.blocks = [Block(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
+        # use_prefix (arg 7 counting the module) is a static python bool
+        block_cls = nn.remat(Block, static_argnums=(7,)) if cfg.remat_blocks else Block
+        self.blocks = [block_cls(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
         self.ln_f = make_norm(cfg, "ln_f")
         if not cfg.tie_embeddings:
             self.lm_head = nn.Dense(

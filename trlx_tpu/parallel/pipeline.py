@@ -178,9 +178,20 @@ def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
     block = Block(cfg)
     n_local = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
 
+    def fwd(lp, h):
+        h_out, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
+        return h_out
+
+    if cfg.remat_blocks:
+        # backward recomputes each layer's internals instead of banking
+        # them across every pipeline tick — cfg.remat_blocks docstring.
+        # prevent_cse=False: inside lax.scan the CSE-prevention barriers
+        # are unnecessary (jax.checkpoint docs) and cost on the hot path.
+        fwd = jax.checkpoint(fwd, prevent_cse=False)
+
     def body(h, xs):
         lp, i = xs
-        h_out, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
+        h_out = fwd(lp, h)
         if freeze_split > 0:
             frozen = (layer_offset + i) < freeze_split
             # value-level select: d/dh is scaled by the 0/1 indicator, so

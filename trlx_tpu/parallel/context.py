@@ -21,6 +21,37 @@ from jax.sharding import Mesh, PartitionSpec as P
 from trlx_tpu.ops.ring_attention import ring_attention
 
 
+def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs, manual):
+    """shard_map manual over `manual` axes only; every other mesh axis
+    stays under GSPMD (auto) control, so rule-table param shardings
+    (fsdp=ZeRO, tensor=TP) keep working INSIDE the manual program — XLA
+    inserts the gather/all-reduce collectives. This is how sequence
+    parallelism composes with TP/FSDP (reference: Megatron SP lives inside
+    a TP group, modeling_nemo_ppo.py:160-164) and how the GPipe program
+    composes with TP/FSDP (trlx_tpu/parallel/pipeline.py).
+
+    When every non-manual axis has size 1 there is nothing to
+    auto-partition and the plain full-manual shard_map is used — which
+    also sidesteps an XLA:CPU crash compiling bf16 collectives under
+    partially-manual meshes (observed on jax 0.9 / 8-device host
+    platform; f32 and full-manual bf16 both compile). Consequence:
+    TP/FSDP-composed programs on the CPU test mesh pin dtype=float32."""
+    manual = set(manual) & set(mesh.axis_names)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if all(sizes[a] == 1 for a in mesh.axis_names if a not in manual):
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            axis_names=manual,
+        )
+    except TypeError:  # older jax: auto= complement instead of axis_names=
+        return shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            auto=frozenset(set(mesh.axis_names) - manual),
+        )
+
+
 def context_parallel_attention(
     mesh: Mesh,
     q: jnp.ndarray,

@@ -89,30 +89,12 @@ def make_pipe_mesh(n_stages: int, devices=None, tensor: int = 1, fsdp: int = 1) 
 
 
 def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs):
-    """shard_map manual over ("data", "pipe"); any other mesh axes
-    (fsdp/tensor) stay auto so GSPMD shards the math inside the body.
+    """GPipe's shard_map: manual over ("data", "pipe"); fsdp/tensor stay
+    GSPMD-auto (see trlx_tpu/parallel/context.py partial_shard_map for
+    the mechanism and the XLA:CPU bf16 caveat)."""
+    from trlx_tpu.parallel.context import partial_shard_map as _psm
 
-    When every non-manual axis has size 1 there is nothing to
-    auto-partition, so the plain (full-manual) shard_map is used — this
-    also sidesteps an XLA:CPU crash compiling bf16 collectives under
-    partially-manual meshes (f32 and full-manual bf16 both compile;
-    observed on jax 0.9 / 8-device host platform). Consequence: TP/FSDP x
-    PP programs on the CPU test mesh should pin dtype=float32 (the
-    pipelined parity tests do anyway, for exact comparisons)."""
-    manual = {"data", PIPE_AXIS} & set(mesh.axis_names)
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    if all(sizes[a] == 1 for a in mesh.axis_names if a not in manual):
-        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    try:
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            axis_names=manual,
-        )
-    except TypeError:  # older jax: auto= complement instead of axis_names=
-        return shard_map(
-            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            auto=frozenset(set(mesh.axis_names) - manual),
-        )
+    return _psm(fn, mesh, in_specs, out_specs, manual={"data", PIPE_AXIS})
 
 
 def stacked_param_shardings(mesh: Mesh, stacked, n_lead: int, rules=None):

@@ -19,7 +19,9 @@ the ring shard-offset default assumes right padding and is bypassed.
 
 Enable with:
     train.trainer: "SequenceParallelPPOTrainer"
-    parallel: {data: D, sequence: S}  (fsdp/tensor/pipeline stay 1)
+    parallel: {data: D, sequence: S}  (+ optional fsdp/tensor: GSPMD-auto
+        inside the shard_map — parallel/context.py partial_shard_map;
+        pipeline stays 1)
 """
 
 from typing import Callable
@@ -120,10 +122,13 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             lp = logprobs_of_labels(logits, labels)
             return lp, values
 
-        smap = shard_map(
-            local_fwd, mesh=mesh,
+        from trlx_tpu.parallel.context import partial_shard_map
+
+        smap = partial_shard_map(
+            local_fwd, mesh,
             in_specs=(P(), spec, spec, spec, spec),
             out_specs=(spec, spec),
+            manual={"data", "sequence"},
         )
 
         def loss_fn(train_params, frozen_params, batch):
@@ -177,10 +182,13 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             ref_lp = logprobs_of_labels(ref_logits, labels)
             return lp, ref_lp, values
 
-        smap = shard_map(
-            local_score, mesh=mesh,
+        from trlx_tpu.parallel.context import partial_shard_map
+
+        smap = partial_shard_map(
+            local_score, mesh,
             in_specs=(P(), P(), spec, spec, spec, spec),
             out_specs=(spec, spec, spec),
+            manual={"data", "sequence"},
         )
 
         def score(train_params, frozen_params, ref_params, all_tokens):

@@ -17,9 +17,10 @@ Enable with:
     train.trainer: "SequenceParallelSFTTrainer"
     train.seq_length: <long, divisible by parallel.sequence>
     tokenizer.padding_side: "right"   (ring positions assume right padding)
-    parallel: {data: D, sequence: S}  (fsdp/tensor/pipeline stay 1: params
-        enter the shard_map replicated — shard_map slices literally, so an
-        fsdp-sharded weight would be a partial matrix)
+    parallel: {data: D, sequence: S}  (+ optional fsdp/tensor: those axes
+        stay GSPMD-auto inside the shard_map, so ZeRO/TP param sharding
+        composes with the sequence axis — parallel/context.py
+        partial_shard_map; pipeline stays 1)
 
 Generation (eval) runs the regular cached decode engine on replicated
 arrays — the einsum path, since cached decode never uses the fused
@@ -50,12 +51,15 @@ logger = logging.get_logger(__name__)
 
 def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> TRLConfig:
     """Shared constraints of the sequence-parallel trainers: a real
-    sequence axis, no fsdp/tensor/pipeline composition (params enter the
-    shard_map replicated — shard_map slices literally, so an fsdp-sharded
-    weight would be a partial matrix with no automatic gather), ring
-    attention forced, divisible seq_length, no MoE (the load-balancing aux
-    loss cannot cross the shard_map program). Returns a COPY of the config
-    with attn_impl='ring' pinned — the caller's config object is left
+    sequence axis, no pipeline composition, ring attention forced,
+    divisible seq_length, no MoE (the load-balancing aux loss cannot
+    cross the shard_map program). fsdp/tensor COMPOSE: they stay
+    GSPMD-auto inside the SP shard_map (parallel/context.py
+    partial_shard_map), so params keep their rule-table shardings and
+    long-context training is no longer capped by one chip's param memory
+    (reference: Megatron SP inside a TP group,
+    modeling_nemo_ppo.py:160-164). Returns a COPY of the config with
+    attn_impl='ring' pinned — the caller's config object is left
     untouched so it can be reused with other trainer families."""
     pc = config.parallel
     if pc.sequence <= 1:
@@ -63,10 +67,11 @@ def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> TRLCo
             f"{cls_name} requires parallel.sequence > 1 "
             "(use the plain trainer otherwise)"
         )
-    if pc.tensor != 1 or pc.fsdp != 1 or getattr(pc, "pipeline", 1) != 1:
+    if getattr(pc, "pipeline", 1) != 1:
         raise NotImplementedError(
-            "sequence parallelism composes with the data axis only; "
-            "set parallel.fsdp/tensor/pipeline to 1"
+            "sequence parallelism does not compose with parallel.pipeline; "
+            "set parallel.pipeline to 1 (or use the Pipelined* trainers "
+            "without a sequence axis)"
         )
     if config.train.seq_length % pc.sequence != 0:
         raise ValueError(
@@ -117,11 +122,14 @@ class SequenceParallelSFTTrainer(SFTTrainer):
             n = jax.lax.psum(jnp.sum(valid), all_axes)
             return s, n
 
-        smap = shard_map(
+        from trlx_tpu.parallel.context import partial_shard_map
+
+        smap = partial_shard_map(
             local_ce,
-            mesh=mesh,
+            mesh,
             in_specs=(P(), batch_spec, batch_spec, batch_spec, batch_spec),
             out_specs=(P(), P()),
+            manual={"data", "sequence"},
         )
 
         def loss_fn(train_params, frozen_params, batch):

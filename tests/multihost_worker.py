@@ -1,0 +1,96 @@
+"""Worker for tests/test_multihost.py: one PPO cycle under a REAL
+2-process jax.distributed cluster (4 CPU devices per process, 8 global).
+
+Run as:  python multihost_worker.py <coordinator> <n_procs> <proc_id>
+
+Prints one MARKER json line with a fingerprint of the rollout store, the
+final loss, and eval stats so the parent can assert host-identical state.
+"""
+
+import json
+import os
+import sys
+import zlib
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["COORDINATOR_ADDRESS"] = sys.argv[1]
+os.environ["NUM_PROCESSES"] = sys.argv[2]
+os.environ["PROCESS_ID"] = sys.argv[3]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trlx_tpu.data.default_configs import default_ppo_config  # noqa: E402
+from trlx_tpu.pipeline import MiniBatchIterator  # noqa: E402
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline  # noqa: E402
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402
+
+
+def main():
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, tracker=None, seed=7),
+        method=dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=6, do_sample=True)),
+        parallel=dict(data=8),  # spans both processes' devices
+    )
+
+    def reward_fn(samples, prompts, outputs, **kw):
+        return [float(len(o)) + o.count("e") for o in outputs]
+
+    trainer = PPOTrainer(config, reward_fn=reward_fn)
+    assert jax.process_count() == int(sys.argv[2])
+    assert jax.device_count() == 8 and len(jax.local_devices()) == 4
+
+    prompts = ["hello world", "jax tpu", "multi host", "ppo test"] * 4
+    trainer.add_prompt_pipeline(
+        PromptPipeline(prompts, max_prompt_length=8, tokenizer=trainer.tokenizer)
+    )
+
+    # one full PPO cycle: experience (sharded reward scoring + allgather
+    # inside) + one optimization epoch
+    trainer.make_experience(config.method.num_rollouts)
+    fingerprint = 0
+    for e in trainer.store.history:
+        for arr in (e.query_tensor, e.response_tensor, e.logprobs, e.values, e.rewards):
+            fingerprint = zlib.crc32(
+                np.ascontiguousarray(np.asarray(arr, np.float32)).tobytes(),
+                fingerprint,
+            )
+
+    loader = trainer.create_train_dataloader()
+    loss = None
+    for mb in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+        stats = trainer.train_minibatch(mb)
+        loss = float(np.asarray(stats["losses"]["total_loss"]))
+        break
+
+    # eval path: generation over the global mesh + rank-0 scoring
+    trainer.eval_dataloader = PromptPipeline(
+        prompts[:8], max_prompt_length=8, tokenizer=trainer.tokenizer
+    ).create_loader(8)
+    results = trainer.evaluate()
+    reward_mean = results.get("reward/mean", -1.0)
+
+    print(json.dumps({
+        "marker": "MULTIHOST_OK",
+        "proc": int(sys.argv[3]),
+        "store_fingerprint": fingerprint,
+        "n_elements": len(trainer.store.history),
+        "loss": round(loss, 6),
+        "mean_kl": round(float(trainer.mean_kl), 6),
+        "reward_mean": round(float(reward_mean), 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -232,9 +232,15 @@ class TPUTrainer(BaseRLTrainer):
 
     def next_rng(self) -> jax.Array:
         self.rng, key = jax.random.split(self.rng)
-        # per-process fold so multi-host samples differ (reference folds
-        # per-DP-rank RNG, modeling_nemo_ppo.py:384-393)
-        return jax.random.fold_in(key, jax.process_index())
+        # IDENTICAL across hosts, deliberately: every host runs the same
+        # global SPMD program over one global batch, so the key must agree
+        # (differing per-host args to a multi-host jit are undefined).
+        # Sampling diversity across data-parallel shards comes from batch
+        # POSITION inside the jitted sampler, not from per-rank keys — the
+        # reference's per-DP-rank fold (modeling_nemo_ppo.py:384-393)
+        # exists because its ranks run separate per-rank sampling loops,
+        # which this design doesn't have.
+        return key
 
     def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm"):
         """Jit-cached generate fn per (shape, kwargs) bucket."""
@@ -690,7 +696,18 @@ class TPUTrainer(BaseRLTrainer):
         """Generate on eval prompts, score with reward_fn/metric_fn
         (reference accelerate_base_trainer.py:339-500). With a list-valued
         gen kwarg the whole pass repeats per value, metrics suffixed
-        @k=v (the reference's generation sweep)."""
+        @k=v (the reference's generation sweep).
+
+        Multi-host: the reference shards its eval loader per rank and
+        gathers generations (accelerate_base_trainer.py:391-402) because
+        each rank runs its own model replica. Here the eval GENERATION is
+        already sharded — one global jitted program over the mesh, batch
+        split across all hosts' devices by GSPMD — so every host runs
+        this identical host loop (cheap decode included) and only rank 0
+        runs reward_fn/metric_fn (user code, possibly non-deterministic)
+        and logs; _post_step broadcasts the save_best verdict. Verified
+        end-to-end by tests/test_multihost.py on a real 2-process
+        cluster."""
         logger.info("Evaluating model")
         clock = Clock()
         stats: Dict[str, Any] = {}

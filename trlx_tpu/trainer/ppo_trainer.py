@@ -299,7 +299,14 @@ class PPOTrainer(TPUTrainer):
     def make_experience(self, num_rollouts: int = 1024, iter_count: int = 0):
         """Collect rollouts: generate -> (host) decode & reward -> jitted
         logprob/value/ref precompute -> per-token KL-penalized rewards ->
-        store (reference accelerate_ppo_trainer.py:251-524)."""
+        store (reference accelerate_ppo_trainer.py:251-524).
+
+        Multi-host: every host runs this identical host loop over the SAME
+        global chunk (device compute is sharded by GSPMD; host work is
+        replicated), except reward scoring, which shards by process and
+        allgathers (_score_samples) — the counterpart of the reference's
+        rank-0 score + scatter (accelerate_ppo_trainer.py:292-338), chosen
+        so a stochastic reward_fn still yields host-identical stores."""
         logger.info("Collecting rollouts")
         if self._score_fn is None:
             self._build_score_fn()
@@ -348,17 +355,10 @@ class PPOTrainer(TPUTrainer):
             metadata = {
                 k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
             }
-            all_scores = self.reward_fn(
-                samples=str_samples,
-                prompts=str_prompts,
-                outputs=str_outputs,
-                tokenizer=self.tokenizer,
-                **metadata,
+            score_rows = self._score_samples(
+                str_samples, str_prompts, str_outputs, metadata
             )
             stats["time/rollout_score"] = clock.tick()
-
-            # scores: [b, S] right-padded with -inf (S=1 for scalar rewards)
-            score_rows = [np.atleast_1d(np.asarray(s, dtype=np.float32)) for s in all_scores]
             S = max(len(r) for r in score_rows)
             scores = np.full((n_samples, S), -np.inf, dtype=np.float32)
             for i, r in enumerate(score_rows):
@@ -479,6 +479,64 @@ class PPOTrainer(TPUTrainer):
     # ------------------------------------------------------------------
     # Loop wiring (reference accelerate_ppo_trainer.py:219-249)
     # ------------------------------------------------------------------
+
+    def _score_samples(self, str_samples, str_prompts, str_outputs, metadata):
+        """reward_fn over a decoded chunk -> list of per-sample score rows
+        (np arrays; length 1 for scalar rewards, >1 for dense).
+
+        Multi-host: each process scores only its slice of the chunk, the
+        padded rows are allgathered, and every host reconstructs the full
+        chunk's scores — one scoring pass total instead of one per host,
+        and host-identical results even for a stochastic reward_fn
+        (reference: rank-0 scoring + scatter,
+        accelerate_ppo_trainer.py:292-338)."""
+        n = len(str_samples)
+        P = jax.process_count()
+
+        def score(sl):
+            rows = self.reward_fn(
+                samples=str_samples[sl],
+                prompts=str_prompts[sl],
+                outputs=str_outputs[sl],
+                tokenizer=self.tokenizer,
+                **{k: v[sl] for k, v in metadata.items()},
+            )
+            return [np.atleast_1d(np.asarray(r, dtype=np.float32)) for r in rows]
+
+        if P == 1:
+            return score(slice(None))
+        from jax.experimental import multihost_utils
+
+        if n % P == 0:
+            p = jax.process_index()
+            nl = n // P
+            local = score(slice(p * nl, (p + 1) * nl))
+        else:
+            # ragged chunk (e.g. a drop_last=False epoch tail): rank 0
+            # scores everything and the gather below broadcasts its rows —
+            # per-host independent scoring would diverge for a stochastic
+            # reward_fn (set_seed offsets np.random per process)
+            nl = n
+            local = (score(slice(None)) if jax.process_index() == 0
+                     else [np.zeros(1, np.float32)] * n)
+
+        # Explicit per-row lengths + a host-agreed width: no truncation of
+        # dense rows longer than max_new, and data values (incl. a user's
+        # interior -inf) survive the round trip untouched.
+        local_w = max((len(r) for r in local), default=1)
+        W = max(int(np.max(multihost_utils.process_allgather(np.int32(local_w)))), 1)
+        buf = np.zeros((nl, W), dtype=np.float32)
+        lens = np.zeros(nl, dtype=np.int32)
+        for i, r in enumerate(local):
+            lens[i] = len(r)
+            buf[i, : len(r)] = r
+        gbuf = np.asarray(multihost_utils.process_allgather(buf))
+        glens = np.asarray(multihost_utils.process_allgather(lens))
+        if n % P == 0:
+            gbuf, glens = gbuf.reshape(n, W), glens.reshape(n)
+        else:
+            gbuf, glens = gbuf[0], glens[0]  # everyone adopts rank 0's rows
+        return [gbuf[i, : max(int(glens[i]), 1)] for i in range(n)]
 
     def add_prompt_pipeline(self, pipeline):
         loader = pipeline.create_loader(self.config.method.chunk_size, shuffle=True)

@@ -25,10 +25,13 @@ def significant(x: Number, ndigits: int = 2) -> Number:
 
 
 def set_seed(seed: int) -> int:
-    """Seed host-side RNGs (python, numpy). Device randomness in JAX is
-    explicit via PRNG keys derived from this seed; per-data-parallel-rank
-    keys are produced with `jax.random.fold_in(key, rank)` (the reference
-    instead re-seeds torch per rank, trlx/utils/__init__.py:44-56)."""
+    """Seed host-side RNGs (python, numpy), offset per process so ad-hoc
+    host randomness differs across hosts. Device randomness is explicit
+    via PRNG keys from this seed; those keys stay IDENTICAL across hosts
+    (trainer.next_rng) because every host feeds the same global SPMD
+    program. Consequence: stochastic host code whose results feed jitted
+    fns must be rank-0-scored + broadcast (PPOTrainer._score_samples does
+    this for reward_fn) — per-host np.random draws would diverge."""
     import jax
 
     seed = int(seed) + jax.process_index()

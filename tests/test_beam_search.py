@@ -68,6 +68,98 @@ def test_beam_search_seq2seq_runs_and_deterministic():
     assert np.asarray(a["response_tokens"]).shape == (2, 7)  # start + max_new
 
 
+def test_beam_sample_matches_exact_python_oracle():
+    """Same-model beam-SAMPLE oracle: a from-scratch per-step python beam
+    expansion consuming the IDENTICAL Gumbel draws (same fold_in schedule)
+    must pick the same winning hypothesis as the jitted scan — this pins
+    the warp->log_softmax->accumulate->Gumbel-top-k order AND the KV-cache
+    reordering by sampled beam index (the oracle recomputes from scratch,
+    so a stale-cache bug would diverge). eos is blocked via
+    min_new_tokens so the (HF-parity-tested) banking path stays out of
+    the comparison."""
+    NEG = -1.0e9
+    mc = ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                     model_extra_configs={"dtype": "float32"})
+    model, cfg, params = build_model(mc, vocab_size=32)
+    B, steps, V, temp = 3, 5, 32, 1.7
+    eos = 31
+    prompt = [5, 6, 7, 8]
+    key = jax.random.PRNGKey(42)
+
+    def logprobs_for(cont):
+        ids = jnp.asarray([prompt + cont], jnp.int32)
+        logits, _, _ = model.apply({"params": params}, ids, jnp.ones_like(ids))
+        # HF order: log_softmax, then processors/warpers on the log-probs
+        # with no renormalization
+        l = np.array(jax.nn.log_softmax(logits[0, -1].astype(jnp.float32)))
+        l[eos] += NEG  # min_new_tokens processor
+        return l / temp
+
+    beams = [(0.0, []), (NEG, []), (NEG, [])]  # scores0 layout
+    for i in range(steps):
+        flat = np.empty(B * V, np.float64)
+        for bi, (score, cont) in enumerate(beams):
+            flat[bi * V:(bi + 1) * V] = score + logprobs_for(cont)
+        g = np.asarray(jax.random.gumbel(jax.random.fold_in(key, i), (1, B * V)),
+                       np.float64)[0]
+        order = np.argsort(-(flat + g), kind="stable")[: 2 * B]
+        c_scores = flat[order]
+        # live continuation: B best of the 2B pool by accumulated score
+        keep = np.argsort(-c_scores, kind="stable")[:B]
+        beams = [
+            (c_scores[j], beams[order[j] // V][1] + [int(order[j] % V)])
+            for j in keep
+        ]
+    expected = beams[int(np.argmax([s for s, _ in beams]))][1]
+
+    gen_cfg = GenerationConfig(max_new_tokens=steps, do_sample=True, num_beams=B,
+                               temperature=temp, min_new_tokens=steps,
+                               eos_token_id=eos, pad_token_id=30)
+    fn = jax.jit(make_generate_fn(model, cfg, gen_cfg))
+    ids = jnp.asarray([prompt], jnp.int32)
+    out = fn(params, ids, jnp.ones_like(ids), key)
+    np.testing.assert_array_equal(np.asarray(out["response_tokens"])[0], expected)
+
+
+def test_beam_sample_stochastic_and_warped():
+    """At a hot temperature different keys give different hypotheses, and
+    the top-k/top-p warps restrict the candidate set without crashing."""
+    mc = ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                     model_extra_configs={"dtype": "float32"})
+    model, cfg, params = build_model(mc, vocab_size=64)
+    ids = jnp.asarray(np.arange(8).reshape(2, 4) % 60 + 1, jnp.int32)
+    mask = jnp.ones_like(ids)
+    gen_cfg = GenerationConfig(max_new_tokens=8, do_sample=True, num_beams=3,
+                               temperature=2.0, top_k=20, top_p=0.95,
+                               eos_token_id=63, pad_token_id=62)
+    fn = jax.jit(make_generate_fn(model, cfg, gen_cfg))
+    outs = [np.asarray(fn(params, ids, mask, jax.random.PRNGKey(k))["response_tokens"])
+            for k in range(4)]
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:]), \
+        "beam-sample produced identical hypotheses across rng keys"
+    # same key -> same draw (the fold is deterministic per step)
+    again = np.asarray(fn(params, ids, mask, jax.random.PRNGKey(0))["response_tokens"])
+    np.testing.assert_array_equal(outs[0], again)
+
+
+def test_beam_search_warper_gate():
+    """Warpers without do_sample are refused (deterministic beam search
+    takes no sampling knobs); repetition_penalty with beams is refused."""
+    mc = ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                     model_extra_configs={"dtype": "float32"})
+    model, cfg, params = build_model(mc, vocab_size=64)
+    with pytest.raises(NotImplementedError, match="do_sample=True"):
+        make_generate_fn(model, cfg, GenerationConfig(
+            max_new_tokens=4, do_sample=False, num_beams=2, top_k=5,
+            eos_token_id=63, pad_token_id=62,
+        ))
+    with pytest.raises(NotImplementedError, match="repetition_penalty"):
+        make_generate_fn(model, cfg, GenerationConfig(
+            max_new_tokens=4, do_sample=True, num_beams=2,
+            repetition_penalty=1.2, eos_token_id=63, pad_token_id=62,
+        ))
+
+
 def test_beam_search_rejects_ilql_and_masks():
     mc = ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
                      model_extra_configs={"dtype": "float32"})
@@ -108,6 +200,54 @@ def test_beam_search_matches_exact_python_beam():
     ids = jnp.asarray([prompt], jnp.int32)
     out = fn(params, ids, jnp.ones_like(ids), jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(out["response_tokens"])[0], expected)
+
+
+def test_beam_search_min_new_tokens_matches_hf(tmp_path):
+    """min_new_tokens under deterministic beams: the EOS block must act
+    on the LOG-PROBS without renormalizing (HF applies processors after
+    log_softmax) — blocking on raw logits would shift every beam's scores
+    by a different -log(1-p_eos) and flip candidate rankings."""
+    torch = pytest.importorskip("torch")
+    import transformers as tf
+
+    from trlx_tpu.models import hf_interop
+
+    torch.manual_seed(3)
+    EOS = 57  # the seed-3 model's favorite continuation — forces the block
+    hf = tf.GPT2LMHeadModel(
+        tf.GPT2Config(vocab_size=64, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+                      bos_token_id=1, eos_token_id=EOS, pad_token_id=62)
+    )
+    hf.eval()
+    hf.save_pretrained(str(tmp_path), safe_serialization=True)
+    cfg = hf_interop.config_from_hf(str(tmp_path), dtype=jnp.float32)
+    model = CausalLMWithValueHead(cfg)
+    tpl = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                     jnp.ones((1, 8), jnp.int32))["params"]
+    params = hf_interop.load_params_from_hf(str(tmp_path), cfg, tpl)
+
+    prompts = torch.tensor([[5, 6, 7, 8], [9, 10, 11, 12]])
+    attn = torch.ones_like(prompts)
+    with torch.no_grad():
+        hf_out = hf.generate(
+            prompts, attention_mask=attn, max_new_tokens=8, min_new_tokens=4,
+            num_beams=3, do_sample=False, early_stopping=False,
+            pad_token_id=62, eos_token_id=EOS,
+        )
+    gen_cfg = GenerationConfig(max_new_tokens=8, min_new_tokens=4,
+                               do_sample=False, num_beams=3,
+                               eos_token_id=EOS, pad_token_id=62)
+    out = jax.jit(make_generate_fn(model, cfg, gen_cfg))(
+        params, jnp.asarray(prompts.numpy().astype(np.int32)),
+        jnp.asarray(attn.numpy().astype(np.int32)), jax.random.PRNGKey(0)
+    )
+    ours = np.asarray(out["response_tokens"])
+    ref = hf_out[:, prompts.shape[1]:].numpy()
+    mask = np.asarray(out["response_mask"])
+    for r in range(ours.shape[0]):
+        n = int(mask[r].sum())
+        np.testing.assert_array_equal(ours[r][:n], ref[r][:n], err_msg=f"row {r}")
+        assert n >= 4  # min_new_tokens honored
 
 
 @pytest.mark.parametrize("lp", [1.0, 2.0])

@@ -48,6 +48,12 @@ EXAMPLES = [
     ("examples.sft_alpaca", {**TINY, "train.seq_length": 160}),
     ("examples.long_context_sft", {**TINY, "train.seq_length": 64}),
     ("examples.summarize_daily_cnn_t5", TINY_PPO),
+    # beam-search rollouts: keep num_beams in the experience kwargs
+    ("examples.ppo_translation_t5", {
+        **TINY_PPO,
+        "train.seq_length": 64,
+        "method.gen_experience_kwargs.max_new_tokens": 4,
+    }),
     ("examples.summarize_rlhf.train_sft", {**TINY, "train.seq_length": 96}),
     ("examples.hh.ppo_hh", TINY_PPO),
     # HH prompts are ~50 byte-tokens; leave room for the output tokens

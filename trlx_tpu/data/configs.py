@@ -205,6 +205,12 @@ class TrainConfig:
     nan_guard: bool = True
     nan_guard_patience: int = 3
 
+    # Generation shape buckets: round generate batches up to multiples of
+    # 8 rows / 32 prompt columns (masked padding, outputs trimmed back)
+    # so ragged eval tails and RFT chunks reuse one compiled program per
+    # bucket instead of compiling per exact shape.
+    bucket_generation: bool = True
+
     # Fuse each inner epoch's optimizer steps into ONE jitted lax.scan
     # dispatch (TPU-idiomatic; a torch trainer can't do this). Semantics
     # are identical — one optimizer update per minibatch — but stats are

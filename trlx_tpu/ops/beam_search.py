@@ -3,7 +3,20 @@
 The reference gets beam search from HF `model.generate(num_beams=...)`
 (used by its seq2seq examples, e.g. examples/ppo_translation_t5.py:99);
 here it is a `lax.scan` over decode steps with the KV cache reordered by
-beam index each step. Deterministic (no sampling).
+beam index each step.
+
+Two modes, matching HF generate:
+- deterministic beam search (`do_sample=False`): top-2B candidates by
+  cumulative logprob;
+- beam-SAMPLE (`do_sample=True`, HF beam search with sampling): HF's
+  exact pipeline — log_softmax first, then processors/warpers
+  (temperature / top-k / top-p) on the LOG-PROBS with no renormalization
+  (`log_probs = logits_processor(..., log_softmax(logits))`,
+  _beam_search) — then the accumulated [b, B*V] scores are sampled
+  2B-without-replacement via the Gumbel-top-k trick (argtop-k of
+  scores + Gumbel == HF's `torch.multinomial(softmax(accumulated), 2B)`,
+  _get_top_k_continuations); gathered scores come from the accumulated
+  values, as HF gathers.
 
 Follows HF's BeamSearchScorer shape: each step takes the top `2*num_beams`
 candidates; candidates ending in EOS are banked into a per-row finished
@@ -26,6 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from trlx_tpu.models.transformer import TransformerConfig, init_kv_cache
+from trlx_tpu.ops.ilql import topk_mask
+from trlx_tpu.ops.sampling import topp_mask
 
 NEG_INF = -1.0e9
 
@@ -51,7 +66,7 @@ def make_beam_generate_fn(
     gen_cfg,  # ops.sampling.GenerationConfig (num_beams > 1)
 ) -> Callable:
     """Build a jittable beam-search generate(params, input_ids, attn_mask,
-    rng) — rng accepted for interface parity, unused (deterministic)."""
+    rng); rng drives beam-sample draws (unused when do_sample=False)."""
     B = gen_cfg.num_beams
     max_new = gen_cfg.max_new_tokens
     lp = gen_cfg.length_penalty
@@ -65,7 +80,7 @@ def make_beam_generate_fn(
         )
         return logits[:, -1].astype(jnp.float32), cache
 
-    def decode(params, cache, last_logits, b, token_dtype):
+    def decode(params, cache, last_logits, b, token_dtype, rng):
         V = last_logits.shape[-1]
         # beam 0 live, others -inf so step 1 picks B distinct tokens
         scores0 = jnp.tile(jnp.asarray([0.0] + [NEG_INF] * (B - 1)), (b, 1))
@@ -81,13 +96,33 @@ def make_beam_generate_fn(
 
         def step(state, i):
             cache, logits, scores, live_toks, fin_scores, fin_toks, fin_masks = state
+            # HF order (_beam_search): log_softmax FIRST, then processors
+            # and (when sampling) warpers act on the log-probs with NO
+            # renormalization — `log_probs = logits_processor(...,
+            # log_softmax(logits))`.
             logprobs = jax.nn.log_softmax(logits, axis=-1)  # [b*B, V]
             if gen_cfg.min_new_tokens > 0:
                 block = jnp.where(i < gen_cfg.min_new_tokens, NEG_INF, 0.0)
                 logprobs = logprobs.at[:, eos].add(block)
+            if gen_cfg.do_sample:
+                if gen_cfg.temperature not in (0.0, 1.0):
+                    logprobs = logprobs / gen_cfg.temperature
+                if gen_cfg.top_k and gen_cfg.top_k > 0:
+                    logprobs = topk_mask(logprobs, gen_cfg.top_k)
+                if gen_cfg.top_p < 1.0:
+                    logprobs = topp_mask(logprobs, gen_cfg.top_p)
             total = scores[:, :, None] + logprobs.reshape(b, B, V)
-            # HF candidate pool: top 2B so EOS hits don't starve live beams
-            c_scores, c_idx = jax.lax.top_k(total.reshape(b, B * V), 2 * B)
+            flat = total.reshape(b, B * V)
+            # HF candidate pool: 2B candidates so EOS hits don't starve
+            # live beams — top-k (beam search), or Gumbel-top-k sampling
+            # without replacement from softmax(accumulated) (beam sample,
+            # HF _get_top_k_continuations' multinomial)
+            if gen_cfg.do_sample:
+                g = jax.random.gumbel(jax.random.fold_in(rng, i), flat.shape)
+                _, c_idx = jax.lax.top_k(flat + g, 2 * B)
+                c_scores = jnp.take_along_axis(flat, c_idx, axis=1)
+            else:
+                c_scores, c_idx = jax.lax.top_k(flat, 2 * B)
             c_beam = c_idx // V  # [b, 2B]
             c_tok = (c_idx % V).astype(token_dtype)
             is_eos = c_tok == eos
@@ -145,7 +180,7 @@ def make_beam_generate_fn(
         mask = _expand_rows(attn_mask, B)
         cache = init_kv_cache(model_cfg, b * B, plen + max_new)
         last_logits, cache = step_model(params, ids, cache, mask, True)
-        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype)
+        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype, rng)
         samples = jnp.concatenate([input_ids, out_tokens], axis=1)
         samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
         return {
@@ -170,7 +205,7 @@ def make_beam_generate_fn(
         start = jnp.full((b * B, 1), start_id, dtype=input_ids.dtype)
         ones = jnp.ones((b * B, 1), jnp.int32)
         last_logits, cache = step_model(params, start, cache, ones, True)
-        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype)
+        out_tokens, out_mask = decode(params, cache, last_logits, b, input_ids.dtype, rng)
         start_col = jnp.full((b, 1), start_id, dtype=input_ids.dtype)
         samples = jnp.concatenate([start_col, out_tokens], axis=1)
         samples_mask = jnp.concatenate([jnp.ones((b, 1), jnp.int32), out_mask], axis=1)

@@ -97,14 +97,20 @@ def process_logits(
     if cfg.top_k and cfg.top_k > 0:
         logits = topk_mask(logits, cfg.top_k)
     if cfg.do_sample and cfg.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens until cumulative prob exceeds top_p (always keep top-1)
-        cutoff_mask = cum - probs >= cfg.top_p
-        threshold = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(axis=-1, keepdims=True)
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+        logits = topp_mask(logits, cfg.top_p)
     return logits
+
+
+def topp_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus mask: keep tokens until cumulative prob exceeds p (always
+    keeping the top-1), set the rest to -inf. Shared by the sampling loop
+    and beam-sample (ops/beam_search.py)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_mask = cum - probs >= p
+    threshold = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(axis=-1, keepdims=True)
+    return jnp.where(logits < threshold, -jnp.inf, logits)
 
 
 def make_generate_fn(
@@ -133,20 +139,22 @@ def make_generate_fn(
                 "num_beams > 1 supports plain LM generation only (no ILQL "
                 "advantage shift or transition logit masks)"
             )
-        if (
-            gen_cfg.do_sample
-            or gen_cfg.temperature not in (0.0, 1.0)
+        if gen_cfg.repetition_penalty != 1.0:
+            raise NotImplementedError(
+                "repetition_penalty under num_beams > 1 is not supported"
+            )
+        if not gen_cfg.do_sample and (
+            gen_cfg.temperature not in (0.0, 1.0)
             or gen_cfg.top_k
             or gen_cfg.top_p < 1.0
-            or gen_cfg.repetition_penalty != 1.0
         ):
-            # refuse rather than silently running deterministic beam search
-            # where HF would beam-SAMPLE: byte-identical rollouts would
-            # quietly kill PPO exploration
+            # refuse rather than silently ignoring warpers: HF's
+            # deterministic beam search likewise takes no warpers —
+            # set do_sample=True for beam-SAMPLE (ops/beam_search.py)
             raise NotImplementedError(
-                "num_beams > 1 is deterministic beam search: set "
-                "do_sample=False and leave temperature/top_k/top_p/"
-                "repetition_penalty at their defaults"
+                "temperature/top_k/top_p with num_beams > 1 require "
+                "do_sample=True (beam sample); deterministic beam search "
+                "takes no sampling knobs"
             )
         from trlx_tpu.ops.beam_search import make_beam_generate_fn
 

@@ -247,11 +247,19 @@ class PipelinedCausalMixin:
     def generate(self, input_ids, attention_mask, gen_kwargs=None, mode: str = "lm"):
         gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
         input_ids = np.asarray(input_ids)
+        attention_mask = np.asarray(attention_mask)
+        if getattr(self.config.train, "bucket_generation", True):
+            input_ids, attention_mask, orig = self._bucket_prompts(
+                input_ids, attention_mask
+            )
+        else:
+            orig = (input_ids.shape[0], 0)
         fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode)
-        return fn(
+        out = fn(
             self.standard_params(), jnp.asarray(input_ids),
-            jnp.asarray(np.asarray(attention_mask)), self.next_rng(),
+            jnp.asarray(attention_mask), self.next_rng(),
         )
+        return self._unbucket_output(out, orig)
 
     def evaluate(self):
         try:

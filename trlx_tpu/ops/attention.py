@@ -281,19 +281,103 @@ def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k, interpret=False):
 def _use_pallas() -> bool:
     # The Pallas call carries no GSPMD partitioning rule, so under a
     # multi-device jit XLA would replicate its operands instead of splitting
-    # the batch. Single chip → Pallas kernel; multi-chip GSPMD → blockwise
-    # XLA (fully partitionable; same math). Ring attention owns the
-    # sequence-sharded case via shard_map.
+    # the batch. Single chip → Pallas kernel; multi-chip GSPMD → the
+    # shard_map-wrapped kernel when a standard mesh is registered
+    # (active_pallas_mesh below), else blockwise XLA (fully partitionable;
+    # same math). Ring attention owns the sequence-sharded case.
     try:
         return jax.default_backend() == "tpu" and jax.device_count() == 1
     except Exception:
         return False
 
 
+# Standard ("data","fsdp","tensor","sequence") mesh registered by
+# MeshRuntime.from_config so kernel dispatch can shard_map the Pallas
+# calls under multi-chip GSPMD layouts. Pipe meshes are never registered
+# (their programs are already manual over data/pipe; nesting would clash).
+_ACTIVE_MESH = None
+
+
+def set_active_pallas_mesh(mesh) -> None:
+    global _ACTIVE_MESH
+    _ACTIVE_MESH = mesh
+
+
+def active_pallas_mesh():
+    """The registered mesh, if Pallas-via-shard_map is applicable: TPU
+    backend, standard 4-axis mesh, sequence axis unsharded."""
+    mesh = _ACTIVE_MESH
+    if mesh is None:
+        return None
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+    except Exception:
+        return None
+    sizes = dict(mesh.shape)
+    if set(sizes) != {"data", "fsdp", "tensor", "sequence"} or sizes["sequence"] != 1:
+        return None
+    return mesh
+
+
+def pallas_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map for shard-local Pallas kernels: disables the varying-axes
+    check (pallas_call outputs carry no vma metadata), handling the kwarg
+    rename across jax versions (check_vma, formerly check_rep). Shared by
+    flash_attention_sharded and fused_ce.fused_logprobs_sharded."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)
+    except TypeError:  # pre-rename API
+        return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                         check_rep=False)
+
+
+def flash_attention_sharded(mesh, q, k, v, mask, causal=True, block_q=128,
+                            block_k=128, interpret=False):
+    """The Pallas forward under a multi-chip mesh: batch shards over
+    (data, fsdp) and heads over tensor, each shard running the kernel on
+    its local block — the multi-chip lift of the single-chip-only gate
+    (round-1 _use_pallas). Full-manual shard_map (every axis named), so
+    no partial-auto lowering is involved. Caller guarantees divisibility
+    (`_sharded_flash_ok`)."""
+    from jax.sharding import PartitionSpec as P
+
+    qkv_spec = P(("data", "fsdp"), None, "tensor", None)
+    fn = pallas_shard_map(
+        functools.partial(
+            _flash_fwd_pallas, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret,
+        ),
+        mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(("data", "fsdp"), None)),
+        out_specs=qkv_spec,
+    )
+    if mask is None:
+        mask = jnp.ones((q.shape[0], k.shape[1]), jnp.int32)
+    return fn(q, k, v, mask)
+
+
+def _sharded_flash_ok(mesh, q, k) -> bool:
+    sizes = dict(mesh.shape)
+    dp = sizes["data"] * sizes["fsdp"]
+    tp = sizes["tensor"]
+    b, _, nh, _ = q.shape
+    nkv = k.shape[2]
+    return b % dp == 0 and nh % tp == 0 and nkv % tp == 0 and (nh // tp) % max(nkv // tp, 1) == 0
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash_attention(q, k, v, mask, causal, block_q, block_k):
     if _use_pallas():
         return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    mesh = active_pallas_mesh()
+    if mesh is not None and _sharded_flash_ok(mesh, q, k):
+        return flash_attention_sharded(mesh, q, k, v, mask, causal, block_q, block_k)
     return blockwise_attention(q, k, v, mask, causal, block_k)
 
 

@@ -125,6 +125,53 @@ def _logprobs_pallas(logits, labels, block_rows=256, block_v=2048, interpret=Fal
     return out[:, 0], lse[:, 0]
 
 
+def fused_logprobs_sharded(mesh, logits, labels, interpret=False):
+    """The streaming-vocab kernel under a multi-chip mesh: rows shard over
+    (data, fsdp) and the VOCAB shards over tensor (the TP lm_head output
+    layout, so no all-gather of the [N, V] logits is forced). Each shard
+    runs the kernel on its local vocab block with labels offset into the
+    local range (out-of-shard labels hit nothing -> zero contribution);
+    the per-shard partial results combine exactly:
+        label_logit = psum(acc)           (one shard owns each label)
+        lse         = logsumexp over shards (max-shifted psum of exps)
+    Full-manual shard_map. Returns (logprobs [N], lse [N])."""
+    from jax.sharding import PartitionSpec as P
+
+    from trlx_tpu.ops.attention import pallas_shard_map
+
+    v = logits.shape[-1]
+    v_local = v // dict(mesh.shape)["tensor"]
+
+    def local_fn(logits_l, labels_g):
+        start = jax.lax.axis_index("tensor") * v_local
+        # Labels outside this shard's [start, start+v_local) range become
+        # -1: the kernel's grid may pad the local vocab up to block_v, and
+        # an off-shard label landing in that phantom tail would otherwise
+        # match a NEG_INF-masked column and poison the psum.
+        in_shard = (labels_g >= start) & (labels_g < start + v_local)
+        labels_l = jnp.where(in_shard, labels_g - start, -1)
+        out_l, lse_l = _logprobs_pallas(logits_l, labels_l, interpret=interpret)
+        label_logit = jax.lax.psum(out_l + lse_l, "tensor")  # acc; 0 off-shard
+        m = jax.lax.pmax(lse_l, "tensor")
+        lse = m + jnp.log(jax.lax.psum(jnp.exp(lse_l - m), "tensor"))
+        return label_logit - lse, lse
+
+    rows = P(("data", "fsdp"))
+    return pallas_shard_map(
+        local_fn,
+        mesh,
+        in_specs=(P(("data", "fsdp"), "tensor"), rows),
+        out_specs=(rows, rows),
+    )(logits, labels)
+
+
+def _sharded_ce_ok(mesh, n: int, v: int) -> bool:
+    sizes = dict(mesh.shape)
+    dp = sizes["data"] * sizes["fsdp"]
+    tp = sizes["tensor"]
+    return n % dp == 0 and v % tp == 0 and (v // tp) >= 8
+
+
 @jax.custom_vjp
 def _fused_logprobs_2d(logits, labels):
     out, _ = _fused_fwd_dispatch(logits, labels)
@@ -134,6 +181,11 @@ def _fused_logprobs_2d(logits, labels):
 def _fused_fwd_dispatch(logits, labels):
     if _use_pallas():
         return _logprobs_pallas(logits, labels)
+    from trlx_tpu.ops.attention import active_pallas_mesh
+
+    mesh = active_pallas_mesh()
+    if mesh is not None and _sharded_ce_ok(mesh, logits.shape[0], logits.shape[1]):
+        return fused_logprobs_sharded(mesh, logits, labels)
     return _logprobs_xla(logits, labels)
 
 

@@ -247,6 +247,13 @@ class MeshRuntime:
                     f"devices but {len(devices)} are available; adjust "
                     "parallel.* or pass a device subset"
                 )
+            # Clear any earlier standard-mesh registration: the GPipe
+            # program is already manual over (data, pipe), and a stale
+            # Pallas-dispatch mesh would nest a shard_map over a DIFFERENT
+            # mesh inside it (ops/attention.py active_pallas_mesh).
+            from trlx_tpu.ops.attention import set_active_pallas_mesh
+
+            set_active_pallas_mesh(None)
             mesh = make_pipe_mesh(pipe, devices=devices, tensor=tensor, fsdp=fsdp)
             logger.info(
                 f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
@@ -266,6 +273,12 @@ class MeshRuntime:
             devices=devices,
         )
         logger.info(f"Device mesh: {dict(zip(MESH_AXES, mesh.devices.shape))}")
+        # Register for Pallas kernel dispatch: on multi-chip TPU layouts the
+        # flash/fused-CE kernels run shard_map-wrapped over this mesh
+        # instead of falling back to the XLA paths (ops/attention.py).
+        from trlx_tpu.ops.attention import set_active_pallas_mesh
+
+        set_active_pallas_mesh(mesh)
         return cls(mesh=mesh)
 
     @property

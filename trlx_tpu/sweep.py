@@ -4,13 +4,20 @@ Parity: `python -m trlx.sweep --config configs/sweeps/ppo_sweep.yml
 examples/ppo_sentiments.py` (reference trlx/sweep.py). The reference builds
 a Ray Tune search space from a yaml file ({strategy, values} per dotted
 config key, sweep.py:17-100) and fans trials out over GPU workers with
-results reported to W&B. TPU-native rebuild: same yaml contract, but trials
-run as local subprocesses (one after another — a TPU chip/slice is a single
-exclusive device, so worker-parallel trials would just contend), each trial
-invokes the example script with a JSON hparams argv (the same contract the
-reference examples use: `json.loads(sys.argv[1])`), metrics land in JSONL
-via the builtin tracker, and the sweep ends with a ranked table +
+results reported to W&B. TPU-native rebuild: same yaml contract, trials run
+as subprocesses (fresh XLA state, crash isolation); each trial invokes the
+example script with a JSON hparams argv (the same contract the reference
+examples use: `json.loads(sys.argv[1])`), metrics land in JSONL via the
+builtin tracker, and the sweep ends with a ranked table +
 sweep_results.json instead of a W&B report.
+
+Fan-out (the Ray Tune worker role): `tune_config.num_workers` runs that
+many trials CONCURRENTLY in slot-based subprocesses; slot s overlays
+`tune_config.worker_env[s]` onto its trials' environment — the dispatch
+hook for separate accelerators/slices (point each slot at its own slice
+via TPU_VISIBLE_DEVICES or coordinator env vars). The default stays 1:
+one TPU chip is one exclusive device, so concurrent local trials would
+only contend.
 
 Usage:
     python -m trlx_tpu.sweep --config sweep.yml examples/randomwalks/ppo_randomwalks.py
@@ -21,6 +28,10 @@ sweep.yml:
         metric: reward/mean
         search_alg: random        # random | grid
         num_samples: 8            # trials (ignored for grid)
+        num_workers: 2            # concurrent trial slots (default 1)
+        worker_env:               # optional per-slot env overlays
+            - {TPU_VISIBLE_DEVICES: "0"}
+            - {TPU_VISIBLE_DEVICES: "1"}
     method.init_kl_coef:
         strategy: loguniform
         values: [0.0001, 0.1]
@@ -133,21 +144,24 @@ def read_metric(logging_dir: str, metric: str, mode: str) -> float:
     return best if best is not None else float("-inf" if mode == "max" else "inf")
 
 
-def run_trial(script: str, hparams: Dict[str, Any], trial_dir: str, env=None) -> int:
-    """One trial = one subprocess (fresh XLA/JAX state, crash isolation —
-    the role Ray workers play in the reference)."""
+def launch_trial(script: str, hparams: Dict[str, Any], trial_dir: str, env=None):
+    """Start one trial subprocess (fresh XLA/JAX state, crash isolation —
+    the role Ray workers play in the reference). Returns (Popen, stdout
+    file handle)."""
     os.makedirs(trial_dir, exist_ok=True)
     hparams = dict(hparams)
     hparams["train.logging_dir"] = trial_dir
     hparams["train.tracker"] = "jsonl"
     with open(os.path.join(trial_dir, "hparams.json"), "w") as f:
         json.dump(hparams, f, indent=2)
-    with open(os.path.join(trial_dir, "stdout.log"), "w") as out:
-        proc = subprocess.run(
-            [sys.executable, script, json.dumps(hparams)],
-            stdout=out, stderr=subprocess.STDOUT, env=env,
-        )
-    return proc.returncode
+    out = open(os.path.join(trial_dir, "stdout.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, script, json.dumps(hparams)],
+        stdout=out, stderr=subprocess.STDOUT, env=env,
+    )
+    return proc, out
+
+
 
 
 def run_sweep(
@@ -156,6 +170,7 @@ def run_sweep(
     output_dir: str = "sweep_results",
     seed: int = 0,
     env: Dict[str, str] = None,
+    num_workers: int = None,
 ) -> Dict[str, Any]:
     tune_config = dict(config.pop("tune_config"))
     metric = tune_config["metric"]
@@ -167,21 +182,69 @@ def run_sweep(
         seed=seed,
     )
 
+    if num_workers is None:
+        num_workers = int(tune_config.get("num_workers", 1))
+    num_workers = max(num_workers, 1)
+    worker_env: List[Dict[str, str]] = tune_config.get("worker_env") or []
+
     stamp = time.strftime("%Y%m%d-%H%M%S")
     sweep_dir = os.path.join(output_dir, f"sweep-{stamp}")
     os.makedirs(sweep_dir, exist_ok=True)
-    logger.info(f"Sweep: {len(trials)} trials of {script} -> {sweep_dir}")
+    logger.info(
+        f"Sweep: {len(trials)} trials of {script} -> {sweep_dir} "
+        f"({num_workers} worker slot(s))"
+    )
 
+    # Slot-based fan-out (the distributed-trial role Ray Tune plays in the
+    # reference, trlx/sweep.py:267-348): up to `num_workers` trials run
+    # concurrently; slot s inherits worker_env[s] on top of `env`, which is
+    # how trials dispatch onto separate TPU slices/hosts (point each slot's
+    # env at a different slice — e.g. TPU_VISIBLE_DEVICES, or
+    # COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID for remote launchers).
+    # num_workers=1 is the single-chip default: a chip is one exclusive
+    # device, so concurrent local trials would only contend.
     results = []
-    for i, hparams in enumerate(trials):
-        trial_dir = os.path.join(sweep_dir, f"trial_{i:03d}")
-        logger.info(f"[trial {i + 1}/{len(trials)}] {hparams}")
-        code = run_trial(script, hparams, trial_dir, env=env)
-        score = read_metric(trial_dir, metric, mode)
-        results.append({
-            "trial": i, "hparams": hparams, "returncode": code, metric: score,
-        })
-        logger.info(f"[trial {i + 1}/{len(trials)}] {metric} = {score}")
+    pending = list(enumerate(trials))[::-1]  # pop() from the front
+    running: Dict[int, Any] = {}  # slot -> (i, hparams, proc, out, trial_dir)
+    try:
+        while pending or running:
+            while pending and len(running) < num_workers:
+                slot = next(s for s in range(num_workers) if s not in running)
+                i, hparams = pending.pop()
+                trial_dir = os.path.join(sweep_dir, f"trial_{i:03d}")
+                trial_env = dict(env) if env is not None else dict(os.environ)
+                if slot < len(worker_env):
+                    trial_env.update({k: str(v) for k, v in worker_env[slot].items()})
+                logger.info(f"[trial {i + 1}/{len(trials)} @ slot {slot}] {hparams}")
+                proc, out = launch_trial(script, hparams, trial_dir, env=trial_env)
+                running[slot] = (i, hparams, proc, out, trial_dir)
+            for slot in list(running):
+                i, hparams, proc, out, trial_dir = running[slot]
+                code = proc.poll()
+                if code is None:
+                    continue
+                out.close()
+                del running[slot]
+                score = read_metric(trial_dir, metric, mode)
+                results.append({
+                    "trial": i, "hparams": hparams, "returncode": code, metric: score,
+                })
+                logger.info(f"[trial {i + 1}/{len(trials)}] {metric} = {score}")
+            if running:
+                time.sleep(0.5)
+    finally:
+        # never orphan trial subprocesses (they may hold TPU slices) or
+        # leak their stdout handles on an exception/KeyboardInterrupt
+        for i, hparams, proc, out, trial_dir in running.values():
+            if proc.poll() is None:
+                logger.warning(f"terminating trial {i} (sweep aborted)")
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            out.close()
+    results.sort(key=lambda r: r["trial"])
 
     reverse = mode == "max"
     ranked = sorted(results, key=lambda r: r[metric], reverse=reverse)
@@ -224,11 +287,18 @@ def main():
     parser.add_argument("--config", type=str, required=True, help="Param-space yaml")
     parser.add_argument("--output-dir", type=str, default="sweep_results")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--num-workers", type=int, default=None,
+        help="Concurrent trial slots (default: tune_config.num_workers or 1; "
+        "pair with tune_config.worker_env to dispatch slots onto separate "
+        "TPU slices)",
+    )
     args = parser.parse_args()
 
     with open(args.config) as f:
         config = yaml.safe_load(f)
-    run_sweep(args.script, config, args.output_dir, args.seed)
+    run_sweep(args.script, config, args.output_dir, args.seed,
+              num_workers=args.num_workers)
 
 
 if __name__ == "__main__":

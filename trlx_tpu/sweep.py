@@ -243,6 +243,7 @@ def run_sweep(
                     proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+                    proc.wait()  # reap: no zombies from a long-lived caller
             out.close()
     results.sort(key=lambda r: r["trial"])
 

@@ -749,12 +749,13 @@ class TPUTrainer(BaseRLTrainer):
         gathers generations (accelerate_base_trainer.py:391-402) because
         each rank runs its own model replica. Here the eval GENERATION is
         already sharded — one global jitted program over the mesh, batch
-        split across all hosts' devices by GSPMD — so every host runs
-        this identical host loop (cheap decode included) and only rank 0
-        runs reward_fn/metric_fn (user code, possibly non-deterministic)
-        and logs; _post_step broadcasts the save_best verdict. Verified
-        end-to-end by tests/test_multihost.py on a real 2-process
-        cluster."""
+        split across all hosts' devices by GSPMD — so every host drives
+        the same generate calls, while the host-side work (device->host
+        copies, string decode, reward_fn/metric_fn — user code, possibly
+        non-deterministic — and logging) runs on rank 0 only; non-zero
+        ranks see empty sample lists. _post_step broadcasts the save_best
+        verdict. Verified end-to-end by tests/test_multihost.py on a real
+        2-process cluster."""
         logger.info("Evaluating model")
         clock = Clock()
         stats: Dict[str, Any] = {}
@@ -777,12 +778,16 @@ class TPUTrainer(BaseRLTrainer):
             clock.tick()  # reset: exclude the previous value's scoring time
             for batch in self.eval_dataloader:
                 out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
-                samples = np.asarray(out["samples"])
-                prompts = np.asarray(batch["input_ids"])
-                str_samples, str_prompts, str_outputs = self.decode(prompts, samples)
-                all_samples += str_samples
-                all_prompts += str_prompts
-                all_outputs += str_outputs
+                if jax.process_index() == 0:
+                    # every host drives the (mesh-sharded) generate calls,
+                    # but only rank 0 scores/logs — skip the host copies
+                    # and string decode elsewhere
+                    samples = np.asarray(out["samples"])
+                    prompts = np.asarray(batch["input_ids"])
+                    str_samples, str_prompts, str_outputs = self.decode(prompts, samples)
+                    all_samples += str_samples
+                    all_prompts += str_prompts
+                    all_outputs += str_outputs
                 metadata = {
                     k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
                 }

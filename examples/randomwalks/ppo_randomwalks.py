@@ -1,6 +1,14 @@
 """PPO on the randomwalks task (parity with reference
-examples/randomwalks/ppo_randomwalks.py, from-scratch tiny model +
-char tokenizer instead of the CarperAI/randomwalks checkpoint)."""
+examples/randomwalks/ppo_randomwalks.py).
+
+The reference starts PPO from the CarperAI/randomwalks hub checkpoint —
+a small LM already trained on valid random walks. Offline, this example
+reproduces that starting point with a WARM-START phase: a quick SFT pass
+over the generated sample walks (the same corpus the hub checkpoint was
+fit on), exported through the HF-interop path, then PPO from the saved
+checkpoint. From a cold random init the walk language itself must be
+discovered before rewards flow, which the reference never asks of PPO;
+set hparams {"warm_start_steps": 0} to skip the phase anyway."""
 
 import os
 import sys
@@ -62,9 +70,53 @@ default_config = TRLConfig(
 )
 
 
+def warm_start(config: TRLConfig, sample_walks, eval_prompts, steps: int) -> str:
+    """SFT the walk language (the CarperAI/randomwalks checkpoint's role)
+    and export it HF-style; returns the checkpoint dir for PPO to load."""
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    sft_config = default_sft_config().evolve(
+        model=dict(model_path=config.model.model_path, num_layers_unfrozen=-1,
+                   model_extra_configs=dict(config.model.model_extra_configs or {})),
+        tokenizer=dict(tokenizer_path=config.tokenizer.tokenizer_path),
+        train=dict(
+            seq_length=config.train.seq_length,
+            batch_size=min(config.train.batch_size, len(sample_walks)),
+            total_steps=steps, epochs=max(steps, 1),
+            eval_interval=10 ** 9, checkpoint_interval=10 ** 9,
+            tracker=None, seed=config.train.seed,
+            checkpoint_dir=config.train.checkpoint_dir + "/warm_sft",
+        ),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+        parallel=config.parallel.__dict__.copy(),
+    )
+    trainer = trlx.train(samples=list(sample_walks), eval_prompts=eval_prompts[:4],
+                         config=sft_config)
+    ckpt = os.path.join(config.train.checkpoint_dir, "warm_start_hf")
+    trainer.save_pretrained(ckpt)  # writes on process 0 only
+    import jax
+
+    if jax.process_count() > 1:
+        # every process loads the checkpoint as model_path next — make
+        # sure rank 0 finished writing before anyone reads
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("randomwalks_warm_start_saved")
+    return ckpt
+
+
 def main(hparams={}):
+    hparams = dict(hparams)
+    warm_steps = int(hparams.pop("warm_start_steps", 100))
     config = TRLConfig.update(default_config, hparams)
-    metric_fn, eval_prompts, *_ = generate_random_walks(seed=config.train.seed)
+    metric_fn, eval_prompts, sample_walks, *_ = generate_random_walks(
+        seed=config.train.seed
+    )
+
+    if warm_steps > 0:
+        config.model.model_path = warm_start(
+            config, sample_walks, eval_prompts, warm_steps
+        )
 
     return trlx.train(
         reward_fn=lambda samples, **kwargs: metric_fn(samples)["optimality"],

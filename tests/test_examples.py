@@ -29,7 +29,8 @@ TINY_RFT = {
 }
 
 EXAMPLES = [
-    ("examples.randomwalks.ppo_randomwalks", {**TINY_PPO, "train.seq_length": 10}),
+    ("examples.randomwalks.ppo_randomwalks",
+     {**TINY_PPO, "train.seq_length": 10, "warm_start_steps": 2}),
     ("examples.randomwalks.ilql_randomwalks", {**TINY, "train.seq_length": 11}),
     ("examples.randomwalks.rft_randomwalks", {**TINY_RFT, "train.seq_length": 10}),
     ("examples.sentiments.ppo_sentiments", TINY_PPO),

@@ -152,6 +152,7 @@ def test_sweep_end_to_end(tmp_path):
         "method.chunk_size": {"strategy": "grid", "values": [4]},
         "method.ppo_epochs": {"strategy": "grid", "values": [1]},
         "method.gen_kwargs.max_new_tokens": {"strategy": "grid", "values": [4]},
+        "warm_start_steps": {"strategy": "grid", "values": [1]},
     }
     env = dict(os.environ)
     env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -160,6 +161,44 @@ def test_sweep_end_to_end(tmp_path):
     assert summary["best"] is not None
     assert summary["best"]["returncode"] == 0, "trial subprocess failed"
     assert np.isfinite(summary["best"]["reward/mean"])
+
+
+def test_save_pretrained_export_is_self_contained(tmp_path):
+    """save_pretrained writes a loadable HF config.json (config_to_hf),
+    so exports round-trip as model.model_path even for models born from
+    random: presets with no source checkpoint — the warm-start -> PPO
+    handoff (examples/randomwalks/ppo_randomwalks.py) depends on this."""
+    import jax
+    from flax import traverse_util
+
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.sft_trainer import SFTTrainer
+
+    def cfg(model_path, sub):
+        return default_sft_config().evolve(
+            model=dict(model_path=model_path, num_layers_unfrozen=-1,
+                       model_extra_configs=dict(dtype="float32")),
+            tokenizer=dict(tokenizer_path="byte"),
+            train=dict(seq_length=32, batch_size=4, tracker=None,
+                       checkpoint_dir=str(tmp_path / sub)),
+            parallel=dict(data=1),
+        )
+
+    src = SFTTrainer(cfg("random:gpt2-tiny", "src"), devices=jax.devices()[:1])
+    out = str(tmp_path / "export")
+    src.save_pretrained(out)
+    assert os.path.exists(os.path.join(out, "config.json"))
+
+    dst = SFTTrainer(cfg(out, "dst"), devices=jax.devices()[:1])
+    flat_src = traverse_util.flatten_dict(src.params)
+    flat_dst = traverse_util.flatten_dict(dst.params)
+    # LM weights round-trip exactly (heads are re-initialized)
+    for k, v in flat_src.items():
+        if k[0] == "lm":
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(flat_dst[k]), atol=1e-6,
+                err_msg="/".join(k),
+            )
 
 
 def test_convert_checkpoint_round_trip(tmp_path):

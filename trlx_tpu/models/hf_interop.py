@@ -72,7 +72,9 @@ def config_from_hf(path: str, **overrides) -> TransformerConfig:
             vocab_size=hf["vocab_size"], d_model=hf["n_embd"], n_layers=hf["n_layer"],
             n_heads=hf["n_head"], d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
             max_seq_len=hf["n_positions"], pos_embed="learned", norm="layernorm",
-            activation="gelu", glu=False, tie_embeddings=True, use_bias=True,
+            activation="gelu", glu=False,
+            tie_embeddings=bool(hf.get("tie_word_embeddings", True)),
+            use_bias=True,
             layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-5),
         )
     elif fam == "llama":
@@ -735,3 +737,90 @@ def params_to_hf_state_dict(params: Dict, cfg: TransformerConfig, family: str = 
     `save_pretrained` interop."""
     family = family or cfg.hf_family or infer_family(cfg)
     return _EXPORTERS[family](params["lm"], cfg)
+
+
+def config_to_hf(cfg: TransformerConfig, family: str = None) -> Dict:
+    """Inverse of config_from_hf: a loadable HF config dict (model_type +
+    architectures included), so `save_pretrained` exports are
+    self-contained — including models born from `random:` presets with no
+    source config.json to copy."""
+    family = family or cfg.hf_family or infer_family(cfg)
+    if family == "gpt2":
+        return dict(
+            model_type="gpt2", architectures=["GPT2LMHeadModel"],
+            vocab_size=cfg.vocab_size, n_embd=cfg.d_model, n_layer=cfg.n_layers,
+            n_head=cfg.n_heads, n_inner=cfg.d_ff, n_positions=cfg.max_seq_len,
+            n_ctx=cfg.max_seq_len, layer_norm_epsilon=cfg.layer_norm_epsilon,
+            activation_function="gelu_new",
+            tie_word_embeddings=cfg.tie_embeddings,
+        )
+    if family == "llama":
+        mistral = cfg.sliding_window is not None
+        return dict(
+            model_type="mistral" if mistral else "llama",
+            architectures=["MistralForCausalLM" if mistral else "LlamaForCausalLM"],
+            vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+            num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+            num_key_value_heads=cfg.kv_heads, intermediate_size=cfg.d_ff,
+            max_position_embeddings=cfg.max_seq_len, rope_theta=cfg.rope_theta,
+            rms_norm_eps=cfg.layer_norm_epsilon,
+            tie_word_embeddings=cfg.tie_embeddings, hidden_act="silu",
+            **({"sliding_window": cfg.sliding_window} if mistral else {}),
+        )
+    if family == "gpt_neox":
+        return dict(
+            model_type="gpt_neox", architectures=["GPTNeoXForCausalLM"],
+            vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+            num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+            intermediate_size=cfg.d_ff, max_position_embeddings=cfg.max_seq_len,
+            rotary_pct=cfg.rotary_pct, rotary_emb_base=cfg.rope_theta,
+            use_parallel_residual=cfg.parallel_residual,
+            tie_word_embeddings=cfg.tie_embeddings,
+            layer_norm_eps=cfg.layer_norm_epsilon,
+            # import maps hidden_act=="gelu" -> gelu_exact, else tanh-gelu
+            hidden_act="gelu" if cfg.activation == "gelu_exact" else "gelu_new",
+        )
+    if family == "gptj":
+        return dict(
+            model_type="gptj", architectures=["GPTJForCausalLM"],
+            vocab_size=cfg.vocab_size, n_embd=cfg.d_model, n_layer=cfg.n_layers,
+            n_head=cfg.n_heads, n_inner=cfg.d_ff, n_positions=cfg.max_seq_len,
+            rotary_dim=cfg.rotary_dim, layer_norm_epsilon=cfg.layer_norm_epsilon,
+            activation_function="gelu_new",
+        )
+    if family == "opt":
+        return dict(
+            model_type="opt", architectures=["OPTForCausalLM"],
+            vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+            num_hidden_layers=cfg.n_layers, num_attention_heads=cfg.n_heads,
+            ffn_dim=cfg.d_ff, max_position_embeddings=cfg.max_seq_len,
+            do_layer_norm_before=True, word_embed_proj_dim=cfg.d_model,
+            activation_function="relu" if cfg.activation == "relu" else "gelu",
+        )
+    if family == "bloom":
+        if cfg.d_ff != 4 * cfg.d_model:
+            # the HF bloom config has no d_ff field (import assumes 4x) —
+            # raise here instead of crashing on kernel shapes at reload
+            raise ValueError(
+                f"bloom export requires d_ff == 4*d_model, got {cfg.d_ff}"
+            )
+        return dict(
+            model_type="bloom", architectures=["BloomForCausalLM"],
+            vocab_size=cfg.vocab_size, hidden_size=cfg.d_model,
+            n_layer=cfg.n_layers, n_head=cfg.n_heads,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+        )
+    if family == "gpt_bigcode":
+        if cfg.kv_heads not in (1, cfg.n_heads):
+            raise ValueError(
+                "gpt_bigcode export supports multi_query (1 kv head) or "
+                f"full MHA only, got n_kv_heads={cfg.kv_heads}"
+            )
+        return dict(
+            model_type="gpt_bigcode", architectures=["GPTBigCodeForCausalLM"],
+            vocab_size=cfg.vocab_size, n_embd=cfg.d_model, n_layer=cfg.n_layers,
+            n_head=cfg.n_heads, n_inner=cfg.d_ff, n_positions=cfg.max_seq_len,
+            multi_query=cfg.kv_heads == 1,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+        )
+    raise ValueError(f"No HF config export for family '{family}'")

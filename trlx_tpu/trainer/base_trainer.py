@@ -940,6 +940,13 @@ class TPUTrainer(BaseRLTrainer):
             sd = params_to_hf_state_dict(params, self.model_cfg)
             torch.save({k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
                        os.path.join(directory, "pytorch_model.bin"))
+            # a loadable HF config.json makes the export self-contained:
+            # the dir can be passed straight back as model.model_path
+            # (incl. models born from random: presets)
+            from trlx_tpu.models.hf_interop import config_to_hf
+
+            with open(os.path.join(directory, "config.json"), "w") as f:
+                json.dump(config_to_hf(self.model_cfg), f, indent=2)
         except Exception as e:  # model family without HF layout — save msgpack
             logger.warning(f"HF export unavailable ({e}); saving flax msgpack instead")
             from flax import serialization

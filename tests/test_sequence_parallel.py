@@ -221,6 +221,66 @@ def test_sequence_parallel_ppo_composes_with_tp(tmp_path):
     )
 
 
+def test_sequence_parallel_ilql_end_to_end_and_loss_parity(tmp_path):
+    """Context-parallel ILQL (the reference's NeMo-ILQL-under-Megatron-SP
+    role, modeling_nemo_ilql.py:612-683): offline RL end-to-end through
+    trlx.train on a data x sequence mesh, target-Q Polyak sync on the
+    sharded layout, and exact loss parity vs the plain ILQLTrainer on
+    identical params/batch."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.data.default_configs import default_ilql_config
+    from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+
+    config = default_ilql_config().evolve(
+        model=dict(model_path="random:llama-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte", padding_side="right"),
+        train=dict(seq_length=64, batch_size=4, total_steps=2, tracker=None,
+                   eval_interval=10, checkpoint_interval=100,
+                   trainer="SequenceParallelILQLTrainer",
+                   checkpoint_dir=str(tmp_path), seed=5),
+        method=dict(steps_for_target_q_sync=1, alpha=1.0,
+                    gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0,
+                                    temperature=1.0)),
+        parallel=dict(data=2, sequence=4),
+    )
+    samples = [("ask", " yes sir"), ("ask", " no sir"),
+               ("question", " maybe so"), ("question", " sure thing")] * 4
+    rewards = [1.0, -1.0, 0.5, 0.2] * 4
+    trainer = trlx.train(samples=samples, rewards=rewards,
+                         eval_prompts=["ask", "question"], config=config)
+    assert trainer.iter_count >= 2
+    assert trainer.model_cfg.attn_impl == "ring"
+
+    # target heads synced (alpha=1 + sync every step => equal to q heads)
+    heads = merge_params(trainer.train_params, trainer.frozen_params)["ilql_heads"]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(heads["q_head_0"]),
+        jax.tree_util.tree_leaves(heads["target_q_head_0"]),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    batch = next(iter(trainer.store.create_loader(4, shuffle=False, drop_last=True)))
+    sp_loss, _ = trainer.make_loss_fn()(
+        trainer.train_params, trainer.frozen_params, trainer.batch_to_device(batch)
+    )
+    host_train = {k: np.asarray(v) for k, v in trainer.train_params.items()}
+    host_frozen = {k: np.asarray(v) for k, v in trainer.frozen_params.items()}
+    plain_cfg = config.evolve(
+        train=dict(trainer="ILQLTrainer"),
+        parallel=dict(data=1, sequence=1),
+        model=dict(model_extra_configs=dict(dtype="float32", attn_impl="xla")),
+    )
+    plain = ILQLTrainer(plain_cfg, devices=jax.devices()[:1])
+    pl_loss, _ = jax.jit(plain.make_loss_fn())(
+        host_train, host_frozen, jax.tree_util.tree_map(jnp.asarray, batch)
+    )
+    np.testing.assert_allclose(
+        float(np.asarray(sp_loss)), float(np.asarray(pl_loss)), rtol=1e-4
+    )
+
+
 def test_sequence_parallel_ppo_validation(tmp_path):
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.trainer.sequence_parallel_ppo_trainer import SequenceParallelPPOTrainer

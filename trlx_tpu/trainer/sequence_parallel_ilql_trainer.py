@@ -1,0 +1,137 @@
+"""Sequence-parallel (context-parallel) ILQL trainer: long-context offline
+RL with the transformer + Q/V-head forwards sharded along sequence and
+ring attention streaming K/V around the `sequence` mesh axis.
+
+Parity target: the reference's NeMo ILQL under Megatron-SP — its loss
+gathers the sequence-parallel tensors and then index-selects the
+action/state positions (modeling_nemo_ilql.py:612-683, SP gather
+:645-657). Same division of labor here, without the explicit gathers:
+
+- INSIDE one partially-manual `shard_map` program (fsdp/tensor stay
+  GSPMD-auto, so ZeRO/TP compose — parallel/context.py partial_shard_map):
+  the full-length trunk forward — logits and the final hidden state —
+  everything elementwise along sequence or a ring collective.
+- OUTSIDE (plain GSPMD on sequence-sharded global arrays): the
+  action/state index-selects on the HIDDENS (they cross shard
+  boundaries; XLA gathers exactly the selected positions) and the
+  Q/target-Q/V heads applied to the small [b, n_actions, d] selections —
+  never materializing vocab-sized per-position Q tensors over the long
+  sequence — then the ILQL loss.
+
+Positions are computed globally from the attention mask and passed in
+explicitly (the ring shard-offset default assumes right padding and is
+bypassed, like SequenceParallelPPOTrainer). Target-Q Polyak sync and
+Q-guided generation are inherited unchanged — generation runs the
+regular cached decode engine on replicated arrays.
+
+Enable with:
+    train.trainer: "SequenceParallelILQLTrainer"
+    parallel: {data: D, sequence: S}  (+ optional fsdp/tensor; pipeline
+        stays 1)
+"""
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from trlx_tpu.data.configs import TRLConfig
+from trlx_tpu.models.transformer import position_ids
+from trlx_tpu.ops.ilql import ilql_loss
+from trlx_tpu.trainer import register_trainer
+from trlx_tpu.trainer.base_trainer import merge_params
+from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+from trlx_tpu.trainer.sequence_parallel_sft_trainer import (
+    validate_sequence_parallel_config,
+)
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+@register_trainer
+class SequenceParallelILQLTrainer(ILQLTrainer):
+    def __init__(self, config: TRLConfig, **kwargs):
+        config = validate_sequence_parallel_config(config, type(self).__name__)
+        if config.model.model_arch_type != "causal":
+            raise NotImplementedError("sequence-parallel ILQL covers causal models")
+        super().__init__(config, **kwargs)
+
+    def create_train_dataloader(self, seed_offset: int = 0):
+        # the shard_map needs every batch divisible by data x fsdp
+        from trlx_tpu.trainer.sequence_parallel_sft_trainer import (
+            warn_if_drop_last_empties_epoch,
+        )
+
+        warn_if_drop_last_empties_epoch(self.store, self.config.train.batch_size)
+        return self.store.create_loader(
+            self.config.train.batch_size, shuffle=True, drop_last=True,
+            seed=self.config.train.seed + self.iter_count + seed_offset,
+        )
+
+    def make_loss_fn(self) -> Callable:
+        from trlx_tpu.models.heads import ILQLHeads
+        from trlx_tpu.parallel.context import partial_shard_map
+
+        model = self.model
+        mcfg = self.model_cfg
+        cfg = self.ilql
+        pad_id = self.tokenizer.pad_token_id
+        mesh = self.runtime.mesh
+        S = self.config.parallel.sequence
+        spec = P("data", "sequence")
+        heads = ILQLHeads(mcfg.vocab_size, cfg.two_qs, mcfg.dtype, mcfg.param_dtype)
+
+        def local_fwd(params, ids, mask, positions):
+            # trunk only: logits + final hidden; the vocab-sized Q heads
+            # run OUTSIDE on the few selected positions, never over the
+            # full long sequence
+            logits, _, h_final = model.apply(
+                {"params": params}, ids, mask, positions, 0,
+                method=lambda m, tokens, attn_mask, pos, split: m.lm(
+                    tokens, attn_mask, pos, split
+                ),
+            )
+            return logits, h_final
+
+        smap = partial_shard_map(
+            local_fwd,
+            mesh,
+            in_specs=(P(), spec, spec, spec),
+            out_specs=(spec, spec),
+            manual={"data", "sequence"},
+        )
+
+        def loss_fn(train_params, frozen_params, batch):
+            params = merge_params(train_params, frozen_params)
+            ids = batch.input_ids
+            t = ids.shape[1]
+            rem = (-t) % S
+            mask = batch.attention_mask
+            if rem:  # right-pad to a sequence-divisible width (masked out)
+                ids_p = jnp.pad(ids, ((0, 0), (0, rem)), constant_values=pad_id)
+                mask_p = jnp.pad(mask, ((0, 0), (0, rem)))
+            else:
+                ids_p, mask_p = ids, mask
+            positions = position_ids(mask_p)  # global (left-pad robust)
+
+            logits, h_final = smap(params, ids_p, mask_p, positions)
+
+            # cross-shard index-selects on the sequence-sharded hiddens
+            # (XLA gathers just the selected positions; the reference
+            # instead gathers the whole SP region first,
+            # modeling_nemo_ilql.py:645-657), then the per-position heads
+            # on the small selections
+            qs, target_qs, vs = heads.apply(
+                {"params": params["ilql_heads"]}, h_final[:, :t],
+                batch.states_ixs, batch.actions_ixs,
+            )
+
+            return ilql_loss(
+                logits[:, :t], qs, target_qs, vs,
+                batch.input_ids, batch.actions_ixs, batch.dones, batch.rewards,
+                tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
+                awac_scale=cfg.awac_scale, beta=cfg.beta,
+            )
+
+        return loss_fn

@@ -93,6 +93,17 @@ def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> TRLCo
     return config.evolve(model=dict(model_extra_configs=extra))
 
 
+def warn_if_drop_last_empties_epoch(store, batch_size: int) -> None:
+    """Shared by the sequence-parallel trainers' drop_last loaders: a
+    store smaller than one batch silently trains ZERO steps."""
+    n = len(store)
+    if n < batch_size:
+        logger.warning(
+            f"store holds {n} samples < batch_size {batch_size}; with "
+            "drop_last the epoch runs ZERO optimizer steps"
+        )
+
+
 @register_trainer
 class SequenceParallelSFTTrainer(SFTTrainer):
     def __init__(self, config: TRLConfig, **kwargs):
@@ -181,14 +192,8 @@ class SequenceParallelSFTTrainer(SFTTrainer):
         # shard_map needs every batch divisible by data x fsdp — drop the
         # ragged tail instead of replicating it (same policy as the
         # pipelined trainers)
-        n = len(self.store)
-        batch_size = self.config.train.batch_size
-        if n < batch_size:
-            logger.warning(
-                f"store holds {n} samples < batch_size {batch_size}; with "
-                "drop_last the epoch runs ZERO optimizer steps"
-            )
+        warn_if_drop_last_empties_epoch(self.store, self.config.train.batch_size)
         return self.store.create_loader(
-            batch_size, shuffle=True, drop_last=True,
+            self.config.train.batch_size, shuffle=True, drop_last=True,
             seed=self.config.train.seed + self.iter_count + seed_offset,
         )

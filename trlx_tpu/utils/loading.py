@@ -26,6 +26,7 @@ for _mod in (
     "trlx_tpu.trainer.pipelined_rft_trainer",
     "trlx_tpu.trainer.sequence_parallel_sft_trainer",
     "trlx_tpu.trainer.sequence_parallel_ppo_trainer",
+    "trlx_tpu.trainer.sequence_parallel_ilql_trainer",
 ):
     try:
         __import__(_mod)

@@ -1,0 +1,126 @@
+"""Sharded generation under pipeline parallelism (VERDICT r2 missing #1).
+
+The regime PP exists for is params > one chip's HBM — so rollout
+collection must not replicate the model. The reference decodes through
+the pipeline every token (modeling_nemo_ppo.py:1028-1093, generate
+:1158-1222); the TPU-native design instead reshards the unstacked view
+over the decode mesh (pipe folds into an fsdp' weight axis,
+PipeMeshRuntime.decode_mesh) so the decoder stays one program while each
+chip holds 1/(pipe*fsdp*tensor) of the params. These tests assert the
+compiled shardings (no matrix leaf replicated across the pipeline
+devices) and decode parity vs a fully-replicated single-program run.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import trlx_tpu as trlx
+from trlx_tpu.data.default_configs import default_ppo_config, default_sft_config
+
+
+def _sft_config(tmp_path, parallel):
+    return default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32", n_layers=4)),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, total_steps=1, tracker=None,
+                   eval_interval=100, checkpoint_interval=100,
+                   trainer="PipelinedSFTTrainer",
+                   checkpoint_dir=str(tmp_path / "pp_dec"), seed=11),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+        parallel=parallel,
+    )
+
+
+@pytest.fixture(scope="module")
+def sft_trainer(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pp_sharded_gen")
+    config = _sft_config(tmp, dict(data=1, pipeline=4, fsdp=2, tensor=1))
+    samples = ["hello world this is text", "another training sample here"] * 8
+    return trlx.train(samples=samples, eval_prompts=["hello"], config=config)
+
+
+def test_decode_view_not_replicated(sft_trainer):
+    """Every matrix leaf of the decode view is sharded across the devices
+    that run the pipeline; replicated residue (LN scales, biases) is a
+    rounding error of total param bytes."""
+    std = sft_trainer.standard_params()
+    n_dev = sft_trainer.runtime.n_devices
+    rep_bytes = tot_bytes = 0
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(std):
+        b = leaf.size * leaf.dtype.itemsize
+        tot_bytes += b
+        if leaf.sharding.is_fully_replicated:
+            rep_bytes += b
+            # tiny head output layers ([d, 1]) legitimately replicate;
+            # anything matrix-sized must not
+            assert leaf.ndim < 2 or leaf.size < 4096, (
+                f"matrix leaf replicated across the pipeline devices: {kp}"
+            )
+        elif leaf.ndim >= 2:
+            # actually split, not just annotated: the addressable shard is
+            # a strict fraction of the leaf
+            shard = leaf.addressable_shards[0].data
+            assert shard.size < leaf.size
+    assert rep_bytes / tot_bytes < 0.05
+    # the decode mesh really covers all pipeline devices
+    assert sft_trainer.runtime.decode_mesh.devices.size == n_dev
+
+
+def test_decode_mesh_folds_pipe_into_fsdp(sft_trainer):
+    sizes = dict(zip(sft_trainer.runtime.decode_mesh.axis_names,
+                     sft_trainer.runtime.decode_mesh.devices.shape))
+    assert sizes == {"data": 1, "fsdp": 8, "tensor": 1}
+
+
+def test_sharded_decode_parity(sft_trainer):
+    """Greedy decode on the sharded view == the same program on a fully
+    replicated host copy of the same params."""
+    trainer = sft_trainer
+    ids = np.full((4, 8), 104, np.int32)
+    ids[:, :3] = np.arange(12).reshape(4, 3) % 7 + 97
+    mask = np.ones_like(ids)
+    key = jax.random.PRNGKey(42)
+
+    fn = trainer.get_generate_fn(4, 8, trainer.generate_kwargs, "lm")
+    out_sharded = fn(trainer.standard_params(), jnp.asarray(ids),
+                     jnp.asarray(mask), key)
+    host_params = jax.tree_util.tree_map(np.asarray, trainer.standard_params())
+    out_repl = fn(host_params, jnp.asarray(ids), jnp.asarray(mask), key)
+    np.testing.assert_array_equal(
+        np.asarray(out_sharded["samples"]), np.asarray(out_repl["samples"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_sharded["samples_mask"]),
+        np.asarray(out_repl["samples_mask"]),
+    )
+
+
+def test_pipelined_ppo_rollouts_sharded(tmp_path):
+    """PipelinedPPOTrainer collects rollouts end-to-end with the sharded
+    decode view (the scenario the reference's 65B config needs)."""
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32", n_layers=4)),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=24, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=100, checkpoint_interval=100,
+                   trainer="PipelinedPPOTrainer",
+                   checkpoint_dir=str(tmp_path / "ppo"), seed=3),
+        method=dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+        parallel=dict(data=1, pipeline=4, fsdp=2, tensor=1),
+    )
+    trainer = trlx.train(
+        reward_fn=lambda samples, **kw: [float(len(s)) for s in samples],
+        prompts=["hello", "world"] * 4,
+        eval_prompts=["hello"],
+        config=config,
+    )
+    assert trainer.iter_count >= 2
+    std = trainer.standard_params()
+    for kp, leaf in jax.tree_util.tree_leaves_with_path(std):
+        if leaf.ndim >= 2 and leaf.size >= 4096:
+            assert not leaf.sharding.is_fully_replicated, kp

@@ -27,10 +27,10 @@ from trlx_tpu.parallel.pipeline import (
 def test_pipe_mesh_axes():
     mesh = make_pipe_mesh(2, tensor=2)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    assert sizes == {"data": 2, "pipe": 2, "fsdp": 1, "tensor": 2}
+    assert sizes == {"data": 2, "pipe": 2, "fsdp": 1, "tensor": 2, "sequence": 1}
     mesh = make_pipe_mesh(2, fsdp=2)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    assert sizes == {"data": 2, "pipe": 2, "fsdp": 2, "tensor": 1}
+    assert sizes == {"data": 2, "pipe": 2, "fsdp": 2, "tensor": 1, "sequence": 1}
 
 
 def test_stacked_param_shardings_rules():

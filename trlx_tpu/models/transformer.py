@@ -555,9 +555,10 @@ class TransformerLM(nn.Module):
                 offset = jax.lax.axis_index("sequence")
             except NameError:
                 # Axis unbound (e.g. flax param init outside shard_map) —
-                # single-shard case; ring_attention likewise degrades to
-                # plain blockwise attention when the axis is unbound.
-                offset = 0
+                # single-shard case: the sequence is unsharded, so the
+                # left-padding-robust cumsum is exact (ring_attention
+                # likewise degrades to plain blockwise attention).
+                return position_ids(attn_mask)
             t = attn_mask.shape[-1]
             return offset * t + jnp.broadcast_to(
                 jnp.arange(t, dtype=jnp.int32)[None, :], attn_mask.shape

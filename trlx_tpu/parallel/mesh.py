@@ -212,13 +212,10 @@ class MeshRuntime:
             # data/pipe are the manual shard_map axes; fsdp/tensor stay
             # GSPMD-auto inside the pipeline program (TP x PP / ZeRO x PP,
             # the reference's megatron_65b.yaml:49-50 TP=8 x PP=4 layout).
-            if (
-                parallel_config.sequence != 1
-                or getattr(parallel_config, "dcn_data", 1) != 1
-            ):
+            if getattr(parallel_config, "dcn_data", 1) != 1:
                 raise NotImplementedError(
-                    "parallel.pipeline composes with data/fsdp/tensor; set "
-                    "sequence/dcn_data to 1"
+                    "parallel.pipeline composes with data/fsdp/tensor/"
+                    "sequence; set dcn_data to 1"
                 )
             from trlx_tpu.parallel.pipeline import make_pipe_mesh
 
@@ -226,24 +223,27 @@ class MeshRuntime:
             pipe = parallel_config.pipeline
             tensor = parallel_config.tensor
             fsdp = parallel_config.fsdp
-            if tensor < 1 or fsdp < 1 or pipe < 1:
+            sequence = parallel_config.sequence
+            if tensor < 1 or fsdp < 1 or pipe < 1 or sequence < 1:
                 # -1 ("rest of the devices") is a data-axis-only idiom on
                 # pipeline meshes; a negative size here would slip through
                 # the coverage check by sign cancellation
                 raise ValueError(
-                    f"parallel.pipeline/fsdp/tensor must be >= 1 on a "
-                    f"pipeline mesh (got pipeline={pipe}, fsdp={fsdp}, "
-                    f"tensor={tensor}); only parallel.data may be -1"
+                    f"parallel.pipeline/fsdp/tensor/sequence must be >= 1 "
+                    f"on a pipeline mesh (got pipeline={pipe}, fsdp={fsdp}, "
+                    f"tensor={tensor}, sequence={sequence}); only "
+                    "parallel.data may be -1"
                 )
             data = parallel_config.data
             if data == -1:
-                data = len(devices) // (pipe * tensor * fsdp)
-            if data * pipe * tensor * fsdp != len(devices):
+                data = len(devices) // (pipe * tensor * fsdp * sequence)
+            if data * pipe * tensor * fsdp * sequence != len(devices):
                 # loud, like _resolve_axis_sizes — silently idling devices
                 # is worse than making the user restrict `devices`
                 raise ValueError(
                     f"data={data} x pipeline={pipe} x fsdp={fsdp} x "
-                    f"tensor={tensor} covers {data * pipe * tensor * fsdp} "
+                    f"tensor={tensor} x sequence={sequence} covers "
+                    f"{data * pipe * tensor * fsdp * sequence} "
                     f"devices but {len(devices)} are available; adjust "
                     "parallel.* or pass a device subset"
                 )
@@ -254,7 +254,8 @@ class MeshRuntime:
             from trlx_tpu.ops.attention import set_active_pallas_mesh
 
             set_active_pallas_mesh(None)
-            mesh = make_pipe_mesh(pipe, devices=devices, tensor=tensor, fsdp=fsdp)
+            mesh = make_pipe_mesh(pipe, devices=devices, tensor=tensor,
+                                  fsdp=fsdp, sequence=sequence)
             logger.info(
                 f"Device mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}"
             )
@@ -370,3 +371,34 @@ class PipeMeshRuntime(MeshRuntime):
     @property
     def stacked_batch_sharding(self) -> NamedSharding:
         return self.sharding(None, "data")
+
+    @property
+    def decode_mesh(self) -> Mesh:
+        """("data", "fsdp", "tensor") view of the SAME devices with the
+        pipe axis folded into fsdp. Generation/export under pipeline
+        parallelism reshards the unstacked param view over THIS mesh
+        (pipelined_mixin.standard_params): every matrix leaf splits over
+        fsdp' = pipe x fsdp (plus tensor), so the decode program holds
+        1/(pipe*fsdp*tensor) of the model per chip instead of a full
+        replicated copy — params fit whenever the devices that run the
+        pipeline fit them, which is the regime PP exists for. The
+        reference instead decodes through the pipeline every token
+        (modeling_nemo_ppo.py:1028-1093, generate :1158-1222); folding
+        pipe into a ZeRO-style weight axis keeps the decoder a single
+        program and lets XLA prefetch each layer's all-gather behind the
+        previous layer's compute."""
+        cached = getattr(self, "_decode_mesh", None)
+        if cached is None:
+            d, p, f, t, s = self.mesh.devices.shape
+            # Merge ADJACENT axes only — (d, p*f, t*s) — so the flat device
+            # order matches the training mesh exactly: standard_params jits
+            # with inputs committed on the training mesh and out_shardings
+            # on this one, and a permuted device assignment would make that
+            # program unloadable (DeviceAssignmentMismatch). Sequence
+            # devices therefore fold into the decode TENSOR axis (cached
+            # decode is a single-sequence-shard program; ring only runs in
+            # training) — Megatron-style decode sharding over t*s ways.
+            arr = self.mesh.devices.reshape(d, p * f, t * s)
+            cached = Mesh(arr, ("data", "fsdp", "tensor"))
+            self._decode_mesh = cached
+        return cached

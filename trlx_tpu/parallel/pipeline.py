@@ -58,43 +58,57 @@ def _varying(x, axis_name: str):
         return x
 
 
-def make_pipe_mesh(n_stages: int, devices=None, tensor: int = 1, fsdp: int = 1) -> Mesh:
-    """("data", "pipe", "fsdp", "tensor") mesh for pipelined trainers.
+def make_pipe_mesh(
+    n_stages: int, devices=None, tensor: int = 1, fsdp: int = 1, sequence: int = 1
+) -> Mesh:
+    """("data", "pipe", "fsdp", "tensor", "sequence") mesh for pipelined
+    trainers.
 
-    "data" and "pipe" are the MANUAL axes of the GPipe shard_map program;
-    "fsdp"/"tensor" stay under GSPMD (auto) control so tensor parallelism
-    and ZeRO param sharding compose with the pipeline without hand-written
-    collectives — XLA inserts the Megatron-style all-reduces from the
-    stacked params' PartitionSpecs (the reference instead nests Apex
-    Column/RowParallelLinear modules inside its pipeline engine,
-    modeling_nemo_ppo.py:93-121, 713-731). "tensor" is innermost so its
-    per-matmul collectives ride the fastest ICI links."""
+    "data", "pipe" and "sequence" are the MANUAL axes of the GPipe
+    shard_map program; "fsdp"/"tensor" stay under GSPMD (auto) control so
+    tensor parallelism and ZeRO param sharding compose with the pipeline
+    without hand-written collectives — XLA inserts the Megatron-style
+    all-reduces from the stacked params' PartitionSpecs (the reference
+    instead nests Apex Column/RowParallelLinear modules inside its
+    pipeline engine, modeling_nemo_ppo.py:93-121, 713-731). With
+    sequence > 1 the pipeline stages run ring attention over the
+    "sequence" axis — the PP x SP composition of the reference's 65B
+    layout (megatron_65b.yaml:49-50 + sequence_parallel: True), except
+    context length scales with chips instead of being capped by one TP
+    group. "sequence" is innermost so the per-block K/V ring ppermutes
+    ride the fastest ICI links."""
     devices = devices if devices is not None else jax.devices()
-    if len(devices) % (n_stages * tensor * fsdp) != 0:
+    if len(devices) % (n_stages * tensor * fsdp * sequence) != 0:
         raise ValueError(
             f"{len(devices)} devices not divisible into {n_stages} stages x "
-            f"fsdp={fsdp} x tensor={tensor}"
+            f"fsdp={fsdp} x tensor={tensor} x sequence={sequence}"
         )
     # Any extra devices form a leading data axis for DP x PP hybrids. Use
     # mesh_utils placement so consecutive pipe stages land on neighboring
     # ICI links (the per-tick ppermute hop), mirroring make_mesh.
-    sizes = (len(devices) // (n_stages * tensor * fsdp), n_stages, fsdp, tensor)
+    sizes = (
+        len(devices) // (n_stages * tensor * fsdp * sequence),
+        n_stages, fsdp, tensor, sequence,
+    )
     try:
         from jax.experimental import mesh_utils
 
         arr = mesh_utils.create_device_mesh(sizes, devices=devices)
     except Exception:  # CPU/host meshes without topology info
         arr = np.asarray(devices).reshape(sizes)
-    return Mesh(arr, ("data", PIPE_AXIS, "fsdp", "tensor"))
+    return Mesh(arr, ("data", PIPE_AXIS, "fsdp", "tensor", "sequence"))
 
 
 def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs):
-    """GPipe's shard_map: manual over ("data", "pipe"); fsdp/tensor stay
-    GSPMD-auto (see trlx_tpu/parallel/context.py partial_shard_map for
-    the mechanism and the XLA:CPU bf16 caveat)."""
+    """GPipe's shard_map: manual over ("data", "pipe", "sequence");
+    fsdp/tensor stay GSPMD-auto (see trlx_tpu/parallel/context.py
+    partial_shard_map for the mechanism and the XLA:CPU bf16 caveat).
+    "sequence" is intersected with the mesh's axes, so meshes without a
+    sequence axis are unaffected."""
     from trlx_tpu.parallel.context import partial_shard_map as _psm
 
-    return _psm(fn, mesh, in_specs, out_specs, manual={"data", PIPE_AXIS})
+    return _psm(fn, mesh, in_specs, out_specs,
+                manual={"data", PIPE_AXIS, "sequence"})
 
 
 def stacked_param_shardings(mesh: Mesh, stacked, n_lead: int, rules=None):
@@ -193,12 +207,18 @@ def gpipe_blocks(
     h: jnp.ndarray,  # [B, t, d] full batch (replicated across pipe axis)
     attn_mask: jnp.ndarray,  # [B, t]
     n_microbatches: int,
+    positions: Optional[jnp.ndarray] = None,  # [B, t] GLOBAL position ids
     axis_name: str = PIPE_AXIS,
     freeze_split: int = 0,
 ) -> jnp.ndarray:
     """Run the block stack as a GPipe pipeline. Must be called inside
     shard_map with `axis_name` bound. Returns [B, t, d] (valid on every
-    stage — the final activations are broadcast from the last stage)."""
+    stage — the final activations are broadcast from the last stage).
+
+    `positions` carries GLOBAL position ids computed before the shard_map
+    (a local cumsum would restart at 0 on every sequence shard and is not
+    left-padding-robust under SP); None falls back to the local cumsum,
+    which is only correct when the sequence dim is unsharded."""
     S = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     my_layers = jax.tree_util.tree_map(lambda x: x[0], stage_params)
@@ -207,38 +227,44 @@ def gpipe_blocks(
     M = n_microbatches
     assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
     mb = B // M
+    if positions is None:
+        positions = position_ids(attn_mask)
     h_mbs = h.reshape(M, mb, t, d)
     mask_mbs = attn_mask.reshape(M, mb, t)
+    pos_mbs = positions.reshape(M, mb, t)
 
     lps = jax.tree_util.tree_leaves(my_layers)[0].shape[0]
 
-    def stage(x, mask):
-        positions = position_ids(mask)
+    def stage(x, mask, pos):
         # shared bias policy with TransformerLM (None => fused kernel
         # builds causal+padding structure blockwise, no O(t^2) tensor)
         bias = train_bias(cfg, mask)
         return _apply_layer_stack(
-            cfg, my_layers, x, bias, positions, mask,
+            cfg, my_layers, x, bias, pos, mask,
             layer_offset=idx * lps, freeze_split=freeze_split,
         )
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
 
     def tick(carry, r):
-        recv_h, recv_mask, out = carry
+        recv_h, recv_mask, recv_pos, out = carry
         r_in = jnp.clip(r, 0, M - 1)
         mb_h = jax.lax.dynamic_index_in_dim(h_mbs, r_in, 0, keepdims=False)
         mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, r_in, 0, keepdims=False)
+        mb_pos = jax.lax.dynamic_index_in_dim(pos_mbs, r_in, 0, keepdims=False)
         x = jnp.where(idx == 0, mb_h, recv_h)
         mask = jnp.where(idx == 0, mb_mask, recv_mask)
-        y = stage(x, mask)
+        pos = jnp.where(idx == 0, mb_pos, recv_pos)
+        y = stage(x, mask, pos)
 
         write_idx = jnp.clip(r - (S - 1), 0, M - 1)
         banked = jax.lax.dynamic_update_index_in_dim(out, y, write_idx, 0)
         out = jnp.where((r >= S - 1) & (idx == S - 1), banked, out)
 
-        next_h, next_mask = jax.lax.ppermute((y, mask), axis_name, fwd_perm)
-        return (next_h, next_mask, out), None
+        next_h, next_mask, next_pos = jax.lax.ppermute(
+            (y, mask, pos), axis_name, fwd_perm
+        )
+        return (next_h, next_mask, next_pos, out), None
 
     # Derive the output bank from `h` (not a fresh jnp.zeros) so it carries
     # h's varying-axis type (e.g. "data" in DP x PP hybrids) — the scan carry
@@ -246,9 +272,10 @@ def gpipe_blocks(
     out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
-        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]), out0),
+        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]),
+         jnp.zeros_like(pos_mbs[0]), out0),
     )
-    (_, _, out), _ = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
+    (_, _, _, out), _ = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
 
     # Broadcast the finished activations from the last stage to all stages
     # (mask-and-psum; one collective, lets unembed/loss run replicated).
@@ -300,6 +327,7 @@ def interleaved_blocks(
     attn_mask: jnp.ndarray,  # [B, t]
     n_microbatches: int,
     n_virtual: int,
+    positions: Optional[jnp.ndarray] = None,  # [B, t] GLOBAL position ids
     axis_name: str = PIPE_AXIS,
     freeze_split: int = 0,
 ) -> jnp.ndarray:
@@ -329,18 +357,20 @@ def interleaved_blocks(
     M = n_microbatches
     assert B % M == 0, f"batch {B} not divisible into {M} microbatches"
     mb = B // M
+    if positions is None:
+        positions = position_ids(attn_mask)
     h_mbs = h.reshape(M, mb, t, d)
     mask_mbs = attn_mask.reshape(M, mb, t)
+    pos_mbs = positions.reshape(M, mb, t)
 
     lps = jax.tree_util.tree_leaves(my_chunks)[0].shape[1]
 
-    def stage(chunk_params, x, mask, loop):
-        positions = position_ids(mask)
+    def stage(chunk_params, x, mask, pos, loop):
         bias = train_bias(cfg, mask)
         # chunk `loop` on device idx covers global layers starting at
         # (loop*S + idx) * lps (the round-robin placement)
         return _apply_layer_stack(
-            cfg, chunk_params, x, bias, positions, mask,
+            cfg, chunk_params, x, bias, pos, mask,
             layer_offset=(loop * S + idx) * lps, freeze_split=freeze_split,
         )
 
@@ -350,7 +380,7 @@ def interleaved_blocks(
     n_ticks = t_last + span
 
     def tick(carry, r):
-        recv_h, recv_mask, out = carry
+        recv_h, recv_mask, recv_pos, out = carry
         base = (r - idx) % S
         w = (r - base) // span
         q = r - base - w * span  # ticks since this mb entered stage 0
@@ -361,30 +391,35 @@ def interleaved_blocks(
         m_in = jnp.clip(m, 0, M - 1)
         mb_h = jax.lax.dynamic_index_in_dim(h_mbs, m_in, 0, keepdims=False)
         mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, m_in, 0, keepdims=False)
+        mb_pos = jax.lax.dynamic_index_in_dim(pos_mbs, m_in, 0, keepdims=False)
         ingest = (idx == 0) & (loop == 0) & valid
         x = jnp.where(ingest, mb_h, recv_h)
         mask = jnp.where(ingest, mb_mask, recv_mask)
+        pos = jnp.where(ingest, mb_pos, recv_pos)
 
         loop_in = jnp.clip(loop, 0, v - 1)
         chunk = jax.tree_util.tree_map(
             lambda p: jax.lax.dynamic_index_in_dim(p, loop_in, 0, keepdims=False),
             my_chunks,
         )
-        y = stage(chunk, x, mask, loop_in)
+        y = stage(chunk, x, mask, pos, loop_in)
 
         bank_now = valid & (idx == S - 1) & (loop == v - 1)
         banked = jax.lax.dynamic_update_index_in_dim(out, y, m_in, 0)
         out = jnp.where(bank_now, banked, out)
 
-        next_h, next_mask = jax.lax.ppermute((y, mask), axis_name, ring_perm)
-        return (next_h, next_mask, out), None
+        next_h, next_mask, next_pos = jax.lax.ppermute(
+            (y, mask, pos), axis_name, ring_perm
+        )
+        return (next_h, next_mask, next_pos, out), None
 
     out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
-        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]), out0),
+        (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]),
+         jnp.zeros_like(pos_mbs[0]), out0),
     )
-    (_, _, out), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+    (_, _, _, out), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
 
     out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
     return out.reshape(B, t, d)
@@ -407,21 +442,21 @@ def make_gpipe_forward_stacked(
     [n_stages, n_virtual, lps, ...] layout and the interleaved schedule
     runs instead of GPipe."""
 
-    def embed(rest_params, tokens, attn_mask):
-        positions = position_ids(attn_mask)
+    def embed(rest_params, tokens, positions):
         return model.apply({"params": {**rest_params}}, tokens, positions, method=model.embed)
 
     def unembed(rest_params, h):
         return model.apply({"params": {**rest_params}}, h, method=model.unembed)
 
-    def inner(stacked, rest, tokens, attn_mask):
-        h = embed(rest, tokens, attn_mask)
+    def inner(stacked, rest, tokens, attn_mask, positions):
+        h = embed(rest, tokens, positions)
         if n_virtual > 1:
             h = interleaved_blocks(cfg, stacked, h, attn_mask, n_microbatches,
-                                   n_virtual, freeze_split=freeze_split)
+                                   n_virtual, positions=positions,
+                                   freeze_split=freeze_split)
         else:
             h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches,
-                             freeze_split=freeze_split)
+                             positions=positions, freeze_split=freeze_split)
         logits, h_final = unembed(rest, h)
         return (logits, h_final) if with_hidden else logits
 
@@ -430,14 +465,25 @@ def make_gpipe_forward_stacked(
     # shard_map's transpose inserts the data-axis grad psum for the
     # replicated params automatically. fsdp/tensor axes (if the mesh has
     # them) stay auto: GSPMD shards the per-stage matmuls from the stacked
-    # params' PartitionSpecs and inserts the TP collectives.
-    out_spec = (P("data"), P("data")) if with_hidden else P("data")
-    return partial_shard_map(
+    # params' PartitionSpecs and inserts the TP collectives. With a real
+    # "sequence" axis (PP x SP) the t dim shards too, and ring attention
+    # inside each stage binds the axis; position ids are computed on the
+    # GLOBAL mask before the shard_map (a shard-local cumsum would restart
+    # at 0 per shard and break left-padded batches).
+    has_seq = "sequence" in mesh.axis_names
+    b_spec = P("data", "sequence") if has_seq else P("data")
+    out_spec = (b_spec, b_spec) if with_hidden else b_spec
+    smap = partial_shard_map(
         inner,
         mesh,
-        in_specs=(P(PIPE_AXIS), P(), P("data"), P("data")),
+        in_specs=(P(PIPE_AXIS), P(), b_spec, b_spec, b_spec),
         out_specs=out_spec,
     )
+
+    def fwd(stacked, rest, tokens, attn_mask):
+        return smap(stacked, rest, tokens, attn_mask, position_ids(attn_mask))
+
+    return fwd
 
 
 def make_gpipe_forward(

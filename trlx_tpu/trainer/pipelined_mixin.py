@@ -33,9 +33,47 @@ logger = logging.get_logger(__name__)
 
 
 class PipelinedCausalMixin:
-    def _validate_pipeline_config(self, config: TRLConfig):
+    # CE-based trainers (SFT/RFT) read the logit at the position BEFORE
+    # each label; under left padding that includes the final pad position
+    # (no valid context — attention output there is impl-defined garbage),
+    # so their PP x SP parity requires right padding. PPO/ILQL only ever
+    # consume logits at valid positions (PPO windows start at the last
+    # real query token and mask by the predicting position), so they keep
+    # their left-padded collation.
+    _sp_needs_right_padding = False
+
+    def _validate_pipeline_config(self, config: TRLConfig) -> TRLConfig:
+        """Validate (and possibly evolve) the config for the pipelined
+        trainer family; call sites must use the RETURNED config. With
+        parallel.sequence > 1 (PP x SP — the reference's 65B layout,
+        megatron_65b.yaml:49-50 + sequence_parallel: True) ring attention
+        is pinned so every pipeline stage shards activations along the
+        sequence axis."""
         if getattr(config.parallel, "pipeline", 1) <= 1:
             raise ValueError(f"{type(self).__name__} requires parallel.pipeline > 1")
+        if getattr(config.parallel, "sequence", 1) > 1:
+            extra = dict(config.model.model_extra_configs or {})
+            if extra.get("attn_impl", "ring") != "ring":
+                raise ValueError(
+                    "pipeline x sequence parallelism uses ring attention; "
+                    "leave model_extra_configs.attn_impl unset or 'ring'"
+                )
+            if extra.get("alibi", False):
+                # ring+alibi silently degrades to the dense einsum path,
+                # which attends shard-locally inside the shard_map — wrong
+                raise NotImplementedError(
+                    "ALiBi under pipeline x sequence parallelism is not "
+                    "supported (the ring kernel cannot express the bias)"
+                )
+            if self._sp_needs_right_padding and config.tokenizer.padding_side != "right":
+                raise ValueError(
+                    f"{type(self).__name__} with parallel.sequence > 1 "
+                    "requires tokenizer.padding_side = 'right': the CE loss "
+                    "reads the logit at the final pad position under left "
+                    "padding, which has no valid context"
+                )
+            extra["attn_impl"] = "ring"
+            config = config.evolve(model=dict(model_extra_configs=extra))
         self._n_virtual = int(getattr(config.parallel, "pipeline_interleave", 1) or 1)
         if self._n_virtual < 1:
             raise ValueError(
@@ -70,6 +108,7 @@ class PipelinedCausalMixin:
                 "MoE under pipeline parallelism is not supported yet "
                 "(the load-balancing aux loss cannot cross the pipeline program)"
             )
+        return config
 
     # ------------------------------------------------------------------
     # Param layout: {"lm_stacked", "lm_rest", <heads...>}
@@ -188,7 +227,12 @@ class PipelinedCausalMixin:
 
     def make_stacked_lm_forward(self, with_hidden: bool = False):
         """fn(stacked, rest, tokens, mask) through the GPipe program, on a
-        fresh TransformerLM module (definitions are pure)."""
+        fresh TransformerLM module (definitions are pure). Under PP x SP
+        (mesh sequence axis > 1) the sequence dim is transparently padded
+        up to a multiple of the axis size and outputs sliced back, so
+        method trainers never see the shard-divisibility constraint
+        (padded columns carry mask 0; the fused kernels ignore masked
+        keys, so valid positions are unchanged)."""
         from trlx_tpu.models.transformer import TransformerLM
 
         # LoRA's split-0 is a hydra concern (ref branch point), not a
@@ -197,29 +241,65 @@ class PipelinedCausalMixin:
         freeze_split = 0 if getattr(self.model_cfg, "lora_rank", 0) > 0 else (
             self.split if self.config.model.num_layers_unfrozen not in (-1, 0) else 0
         )
-        return make_gpipe_forward_stacked(
+        fwd = make_gpipe_forward_stacked(
             TransformerLM(self.model_cfg), self.model_cfg, self.runtime.mesh,
             n_microbatches=self._n_microbatches, with_hidden=with_hidden,
             n_virtual=self._n_virtual, freeze_split=freeze_split,
         )
+        mesh = self.runtime.mesh
+        seq_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sequence", 1)
+        if seq_ways == 1:
+            return fwd
+
+        def fwd_padded(stacked, rest, tokens, attn_mask):
+            t = tokens.shape[1]
+            rem = (-t) % seq_ways
+            if rem:
+                tokens = jnp.pad(tokens, ((0, 0), (0, rem)))
+                attn_mask = jnp.pad(attn_mask, ((0, 0), (0, rem)))
+            out = fwd(stacked, rest, tokens, attn_mask)
+            if with_hidden:
+                logits, h_final = out
+                return logits[:, :t], h_final[:, :t]
+            return out[:, :t]
+
+        return fwd_padded
 
     def standard_params(self) -> Dict:
         """Unstacked view in the regular model layout (for generation,
-        HF export, and interop). Cached per optimizer step — evaluate()
-        calls generate once per eval batch (x sweep values) and must not
-        re-materialize the full model each time."""
+        HF export, and interop), SHARDED over the decode mesh — the pipe
+        axis folds into an fsdp' weight axis (PipeMeshRuntime.decode_mesh)
+        so no leaf is replicated across the pipeline devices and models
+        that only fit sharded can still collect rollouts / run eval. The
+        reshape+reshard runs as one jitted program with out_shardings, so
+        a full replicated copy is never materialized at any point. Cached
+        per optimizer step — evaluate() calls generate once per eval batch
+        (x sweep values) and must not re-materialize the view each time."""
         cached = getattr(self, "_std_params_cache", None)
         if cached is not None and cached[0] == self.iter_count:
             return cached[1]
-        params = merge_params(self.train_params, self.frozen_params)
-        lm = unstack_block_params_interleaved(
-            params["lm_stacked"], params["lm_rest"], self.model_cfg.n_layers,
-            self._n_virtual,
-        )
-        out = {"lm": lm}
-        for k, v in params.items():
-            if k not in ("lm_stacked", "lm_rest"):
-                out[k] = v
+        build = getattr(self, "_std_params_build", None)
+        if build is None:
+            n_layers, n_virtual = self.model_cfg.n_layers, self._n_virtual
+
+            def _build(train, frozen):
+                params = merge_params(train, frozen)
+                lm = unstack_block_params_interleaved(
+                    params["lm_stacked"], params["lm_rest"], n_layers, n_virtual
+                )
+                out = {"lm": lm}
+                for k, v in params.items():
+                    if k not in ("lm_stacked", "lm_rest"):
+                        out[k] = v
+                return out
+
+            from trlx_tpu.parallel import infer_param_shardings
+
+            abstract = jax.eval_shape(_build, self.train_params, self.frozen_params)
+            shardings = infer_param_shardings(self.runtime.decode_mesh, abstract)
+            build = jax.jit(_build, out_shardings=shardings)
+            self._std_params_build = build
+        out = build(self.train_params, self.frozen_params)
         self._std_params_cache = (self.iter_count, out)
         return out
 
@@ -265,8 +345,9 @@ class PipelinedCausalMixin:
         try:
             return super().evaluate()
         finally:
-            # release the replicated unstacked copy: it must not occupy
-            # HBM during training steps on models that only fit sharded
+            # release the decode-sharded unstacked view: even at
+            # 1/(pipe*fsdp) per chip it must not occupy HBM alongside the
+            # stacked params during training steps
             self._std_params_cache = None
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs):

@@ -14,10 +14,13 @@ design:
   infer_logprobs_and_values, but the reference lives as a second stacked
   param tree sharded over the pipe axis instead of CPU<->GPU weight
   swaps;
-- generation uses the sampling engine on a per-collection-cached
-  unstacked view (NeMo instead decodes through the pipeline every token;
-  we trade replicated-generation memory for a single-program decoder —
-  models that only fit sharded should lower chunk_size/eval cadence).
+- generation uses the sampling engine on a per-step-cached unstacked
+  view SHARDED over the decode mesh (pipe folds into an fsdp' weight
+  axis — PipeMeshRuntime.decode_mesh): NeMo instead decodes through the
+  pipeline every token (modeling_nemo_ppo.py:1028-1093); here the
+  decoder stays a single program while each chip holds only
+  1/(pipe*fsdp*tensor) of the params, so models that need PP to fit can
+  still collect rollouts.
 
 Enable with:
     train.trainer: "PipelinedPPOTrainer"
@@ -55,7 +58,7 @@ logger = logging.get_logger(__name__)
 @register_trainer
 class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
-        self._validate_pipeline_config(config)
+        config = self._validate_pipeline_config(config)
         if getattr(config.method, "num_value_layers_unfrozen", 0):
             raise NotImplementedError(
                 "num_value_layers_unfrozen (the deeper value branch) is not "

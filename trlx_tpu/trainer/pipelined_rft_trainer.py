@@ -18,8 +18,10 @@ from trlx_tpu.trainer.sft_trainer import causal_lm_ce_loss
 
 @register_trainer
 class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
+    _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
+
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
-        self._validate_pipeline_config(config)
+        config = self._validate_pipeline_config(config)
         self._n_microbatches = n_microbatches
         super().__init__(config, **kwargs)
 

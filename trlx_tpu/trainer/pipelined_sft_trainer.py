@@ -39,8 +39,10 @@ logger = logging.get_logger(__name__)
 
 @register_trainer
 class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
+    _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
+
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
-        self._validate_pipeline_config(config)
+        config = self._validate_pipeline_config(config)
         self._n_microbatches = n_microbatches
         super().__init__(config, **kwargs)
 

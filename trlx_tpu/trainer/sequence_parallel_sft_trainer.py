@@ -69,9 +69,9 @@ def validate_sequence_parallel_config(config: TRLConfig, cls_name: str) -> TRLCo
         )
     if getattr(pc, "pipeline", 1) != 1:
         raise NotImplementedError(
-            "sequence parallelism does not compose with parallel.pipeline; "
-            "set parallel.pipeline to 1 (or use the Pipelined* trainers "
-            "without a sequence axis)"
+            f"{cls_name} is the single-program SP family; for PP x SP use "
+            "the Pipelined* trainers with parallel.sequence > 1 (ring "
+            "attention runs inside every pipeline stage)"
         )
     if config.train.seq_length % pc.sequence != 0:
         raise ValueError(

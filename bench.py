@@ -2,9 +2,21 @@
 
 Measures full PPO cycles — experience collection (jitted autoregressive
 generation + host reward + jitted fused policy/value/reference scoring)
-followed by `ppo_epochs` optimization passes over the rollout store — i.e.
-the reference's AcceleratePPOTrainer hot path (make_experience + learn
-inner loop, SURVEY.md §3.2-3.3).
+followed by `ppo_epochs` optimization passes — i.e. the reference's
+AcceleratePPOTrainer hot path (make_experience + learn inner loop,
+SURVEY.md §3.2-3.3).
+
+The default timed path is `trainer.pipelined_cycle`: the same per-cycle
+math (generation, host reward_fn, policy/value/ref scoring, per-token
+reward construction, all `ppo_epochs` optimizer epochs — the in-graph
+reward construction is pinned element-for-element to the classic store
+path by tests/test_pipelined_cycle.py), restructured to keep
+logprobs/values/rewards device-resident and pay exactly ONE blocking
+host fetch per iteration. It bypasses the numpy rollout store/collation
+and logging; `--classic` times the store-based make_experience + fused
+train path instead (three blocking fetches per cycle — each costs a full
+~100ms RTT on this environment's relay-tunneled TPU backend, vs ~0.1ms
+co-located).
 
 Workload = the reference's DEFAULT PPO configuration
 (/root/reference/trlx/data/default_configs.py:17-59), at full fidelity:
@@ -117,7 +129,14 @@ def build_trainer(smoke: bool = False):
 
 
 def run_cycle(trainer, config):
-    """One full PPO iteration: collect rollouts, then optimize over them."""
+    """One full PPO iteration via the CLASSIC store path (--classic):
+    collect rollouts, then optimize over them. The default bench path is
+    trainer.pipelined_cycle — same math (tests/test_pipelined_cycle.py
+    pins the in-graph reward construction to the classic block
+    element-for-element) with ONE blocking host fetch per iteration
+    instead of three; on the relay-tunneled backend this environment
+    provides, each blocking fetch costs a full ~100ms RTT that a
+    co-located host would not pay."""
     from trlx_tpu.pipeline import MiniBatchIterator
 
     trainer.store.clear_history()
@@ -245,18 +264,34 @@ def main():
             f"max|dev| {parity['fused_ce_max_dev']:.2e} (vocab 50257)\n"
         )
 
+    classic = "--classic" in sys.argv
     trainer, config = build_trainer(smoke)
     n_chips = max(jax.device_count(), 1)
 
-    run_cycle(trainer, config)  # warmup: compiles generate/score/train steps
-    warm = time.time()
-
     min_cycles, min_seconds = (1, 0.0) if smoke else (5, 10.0)
     cycles = 0
-    while cycles < min_cycles or (time.time() - warm) < min_seconds:
-        run_cycle(trainer, config)
-        cycles += 1
-    elapsed = time.time() - warm
+    if classic:
+        run_cycle(trainer, config)  # warmup: compiles generate/score/train
+        warm = time.time()
+        while cycles < min_cycles or (time.time() - warm) < min_seconds:
+            run_cycle(trainer, config)
+            cycles += 1
+        elapsed = time.time() - warm
+    else:
+        # warmup: two cycles trigger every compile (generate, score+reward,
+        # fused train scan) and prime the cross-cycle pipeline
+        _, pending = trainer.pipelined_cycle()
+        _, pending = trainer.pipelined_cycle(pending)
+        # drain the warmup backlog COMPLETELY (train loss + the pre-
+        # dispatched generate) so the timed window starts quiescent
+        _ = jax.device_get((pending[2][0], pending[1]["samples"]))
+        warm = time.time()
+        while cycles < min_cycles or (time.time() - warm) < min_seconds:
+            _, pending = trainer.pipelined_cycle(pending)
+            cycles += 1
+        # the timing window closes on a full sync of the last cycle's train
+        _ = float(np.asarray(pending[2][0]))
+        elapsed = time.time() - warm
 
     n_new = config.method.gen_kwargs["max_new_tokens"]
     n_prompt = N_PROMPT if not smoke else 16

@@ -344,65 +344,9 @@ class PPOTrainer(TPUTrainer):
             samples = np.asarray(out["samples"])  # materialize (also syncs device)
             stats["time/rollout_generate"] = clock.tick()
 
-            prompt_tensors = np.asarray(batch["input_ids"])
-            n_samples = len(samples)
-            prompt_sizes = [prompt_tensors.shape[1]] * n_samples
-
-            str_samples, str_prompts, str_outputs = self.decode(
-                prompt_tensors, samples, prompt_sizes, append_eos_token=True
+            prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
+                self._host_process_chunk(batch, samples, stats, clock)
             )
-
-            metadata = {
-                k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
-            }
-            score_rows = self._score_samples(
-                str_samples, str_prompts, str_outputs, metadata
-            )
-            stats["time/rollout_score"] = clock.tick()
-            S = max(len(r) for r in score_rows)
-            scores = np.full((n_samples, S), -np.inf, dtype=np.float32)
-            for i, r in enumerate(score_rows):
-                scores[i, : len(r)] = r
-            scores_mask = scores != -np.inf
-
-            # Re-tokenize the (possibly stop-trimmed) outputs and right-pad
-            # to the static response width.
-            outputs = [
-                self.tokenizer.encode(o, add_special_tokens=False)[:max_new]
-                for o in str_outputs
-            ]
-            if self.seq2seq:
-                # decoder-side responses start with decoder_start_token
-                start_id = int(getattr(self.model_cfg, "decoder_start_token_id", pad_id))
-                sample_outputs = np.full((n_samples, 1 + max_new), pad_id, dtype=np.int32)
-                sample_outputs[:, 0] = start_id
-                for i, o in enumerate(outputs):
-                    sample_outputs[i, 1 : 1 + len(o)] = o
-            else:
-                sample_outputs = np.full((n_samples, max_new), pad_id, dtype=np.int32)
-                for i, o in enumerate(outputs):
-                    sample_outputs[i, : len(o)] = o
-
-            if method.cliprange_reward:
-                scores = np.where(
-                    scores_mask,
-                    np.clip(scores, -method.cliprange_reward, method.cliprange_reward),
-                    scores,
-                )
-
-            # Reward scaling stats (reference accelerate_ppo_trainer.py:364-380)
-            sample_scores = (np.where(scores_mask, scores, 0.0)).sum(axis=1)
-            if self.ref_mean is None:
-                self.ref_mean, self.ref_std = float(sample_scores.mean()), float(sample_scores.std())
-            all_scores_mean, all_scores_std = self.running_moments.update(sample_scores)
-            stats["rollout_scores/mean"] = all_scores_mean
-            stats["rollout_scores/std"] = all_scores_std
-            stats["rollout_scores/running_mean"] = self.running_moments.mean
-            stats["rollout_scores/running_std"] = self.running_moments.std
-            if method.scale_reward == "running":
-                scores = np.where(scores_mask, scores / max(self.running_moments.std, 1e-8), scores)
-            elif method.scale_reward == "ref":
-                scores = np.where(scores_mask, scores / max(self.ref_std, 1e-8), scores)
 
             # Jitted precompute of logprobs/values/ref KL
             if self.seq2seq:
@@ -425,41 +369,10 @@ class PPOTrainer(TPUTrainer):
             mean_kl = float(mean_kl)
             mean_kl_per_token = float(mean_kl_per_token)
 
-            # Slice per-sample response windows: logprob[i] is the (log)prob
-            # with which all_tokens[i+1] was sampled. For seq2seq everything
-            # is decoder-relative, so the window starts at 0.
-            start = 0 if self.seq2seq else prompt_tensors.shape[1] - 1
-            kl_penalty = -self.kl_ctl.value * log_ratio
-
-            for ix in range(n_samples):
-                if self.seq2seq:
-                    n_resp = max(len(outputs[ix]), 1)
-                    response_tensor = sample_outputs[ix, : n_resp + 1]
-                else:
-                    n_resp = int((sample_outputs[ix] != pad_id).sum())
-                    if n_resp == 0:
-                        n_resp = 1  # degenerate empty response: keep one slot
-                    response_tensor = sample_outputs[ix, :n_resp]
-                end = start + n_resp
-                rewards = kl_penalty[ix, start:end].copy()
-                if scores.shape[1] == 1:
-                    # scalar score lands on the final token (HHH practice)
-                    rewards[-1] += scores[ix, 0]
-                else:
-                    score_len = int(scores_mask[ix].sum())
-                    dense = scores[ix, :score_len]
-                    dense = dense[: len(rewards)]
-                    rewards[: len(dense)] += dense
-
-                ppo_rl_elements.append(
-                    PPORLElement(
-                        query_tensor=prompt_tensors[ix],
-                        response_tensor=response_tensor,
-                        logprobs=logprobs[ix, start:end],
-                        values=values[ix, start:end],
-                        rewards=rewards,
-                    )
-                )
+            ppo_rl_elements.extend(self._chunk_to_elements(
+                prompt_tensors, sample_outputs, outputs, scores, scores_mask,
+                logprobs, values, log_ratio,
+            ))
 
             stats["time/rollout_time"] = clock.tick()
             stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
@@ -538,6 +451,120 @@ class PPOTrainer(TPUTrainer):
             gbuf, glens = gbuf[0], glens[0]  # everyone adopts rank 0's rows
         return [gbuf[i, : max(int(glens[i]), 1)] for i in range(n)]
 
+    def _host_process_chunk(self, batch, samples, stats=None, clock=None):
+        """The host stage of one rollout chunk: decode -> reward_fn ->
+        retokenize/right-pad the (possibly stop-trimmed) outputs ->
+        clip -> running-moments reward scaling. Shared by make_experience
+        and pipelined_cycle so the two cycle paths cannot drift
+        (reference accelerate_ppo_trainer.py:303-380). Returns
+        (prompt_tensors, sample_outputs, outputs, scores, scores_mask);
+        records score timing + rollout_scores stats into `stats`."""
+        method = self.config.method
+        pad_id = self.tokenizer.pad_token_id
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        max_new = int(gen_kwargs.get("max_new_tokens", 40))
+
+        prompt_tensors = np.asarray(batch["input_ids"])
+        n_samples = len(samples)
+        prompt_sizes = [prompt_tensors.shape[1]] * n_samples
+        str_samples, str_prompts, str_outputs = self.decode(
+            prompt_tensors, samples, prompt_sizes, append_eos_token=True
+        )
+        metadata = {
+            k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
+        }
+        score_rows = self._score_samples(str_samples, str_prompts, str_outputs, metadata)
+        if stats is not None and clock is not None:
+            stats["time/rollout_score"] = clock.tick()
+        S = max(len(r) for r in score_rows)
+        scores = np.full((n_samples, S), -np.inf, dtype=np.float32)
+        for i, r in enumerate(score_rows):
+            scores[i, : len(r)] = r
+        scores_mask = scores != -np.inf
+
+        outputs = [
+            self.tokenizer.encode(o, add_special_tokens=False)[:max_new]
+            for o in str_outputs
+        ]
+        if self.seq2seq:
+            # decoder-side responses start with decoder_start_token
+            start_id = int(getattr(self.model_cfg, "decoder_start_token_id", pad_id))
+            sample_outputs = np.full((n_samples, 1 + max_new), pad_id, dtype=np.int32)
+            sample_outputs[:, 0] = start_id
+            for i, o in enumerate(outputs):
+                sample_outputs[i, 1 : 1 + len(o)] = o
+        else:
+            sample_outputs = np.full((n_samples, max_new), pad_id, dtype=np.int32)
+            for i, o in enumerate(outputs):
+                sample_outputs[i, : len(o)] = o
+
+        if method.cliprange_reward:
+            scores = np.where(
+                scores_mask,
+                np.clip(scores, -method.cliprange_reward, method.cliprange_reward),
+                scores,
+            )
+
+        # Reward scaling stats (reference accelerate_ppo_trainer.py:364-380)
+        sample_scores = (np.where(scores_mask, scores, 0.0)).sum(axis=1)
+        if self.ref_mean is None:
+            self.ref_mean, self.ref_std = float(sample_scores.mean()), float(sample_scores.std())
+        all_scores_mean, all_scores_std = self.running_moments.update(sample_scores)
+        if stats is not None:
+            stats["rollout_scores/mean"] = all_scores_mean
+            stats["rollout_scores/std"] = all_scores_std
+            stats["rollout_scores/running_mean"] = self.running_moments.mean
+            stats["rollout_scores/running_std"] = self.running_moments.std
+        if method.scale_reward == "running":
+            scores = np.where(scores_mask, scores / max(self.running_moments.std, 1e-8), scores)
+        elif method.scale_reward == "ref":
+            scores = np.where(scores_mask, scores / max(self.ref_std, 1e-8), scores)
+        return prompt_tensors, sample_outputs, outputs, scores, scores_mask
+
+    def _chunk_to_elements(self, prompt_tensors, sample_outputs, outputs,
+                           scores, scores_mask, logprobs, values, log_ratio):
+        """Slice per-sample response windows into PPORLElements (host
+        numpy). logprob[i] is the (log)prob with which all_tokens[i+1] was
+        sampled; for seq2seq everything is decoder-relative, so the window
+        starts at 0. The in-graph reward construction of the pipelined
+        cycle (_build_score_reward_fn) mirrors this block exactly — the
+        parity test ties them together."""
+        pad_id = self.tokenizer.pad_token_id
+        start = 0 if self.seq2seq else prompt_tensors.shape[1] - 1
+        kl_penalty = -self.kl_ctl.value * log_ratio
+
+        elements = []
+        for ix in range(len(sample_outputs)):
+            if self.seq2seq:
+                n_resp = max(len(outputs[ix]), 1)
+                response_tensor = sample_outputs[ix, : n_resp + 1]
+            else:
+                n_resp = int((sample_outputs[ix] != pad_id).sum())
+                if n_resp == 0:
+                    n_resp = 1  # degenerate empty response: keep one slot
+                response_tensor = sample_outputs[ix, :n_resp]
+            end = start + n_resp
+            rewards = kl_penalty[ix, start:end].copy()
+            if scores.shape[1] == 1:
+                # scalar score lands on the final token (HHH practice)
+                rewards[-1] += scores[ix, 0]
+            else:
+                score_len = int(scores_mask[ix].sum())
+                dense = scores[ix, :score_len]
+                dense = dense[: len(rewards)]
+                rewards[: len(dense)] += dense
+
+            elements.append(
+                PPORLElement(
+                    query_tensor=prompt_tensors[ix],
+                    response_tensor=response_tensor,
+                    logprobs=logprobs[ix, start:end],
+                    values=values[ix, start:end],
+                    rewards=rewards,
+                )
+            )
+        return elements
+
     def add_prompt_pipeline(self, pipeline):
         loader = pipeline.create_loader(self.config.method.chunk_size, shuffle=True)
         self.prompt_iterator = infinite_dataloader(loader)
@@ -547,6 +574,181 @@ class PPOTrainer(TPUTrainer):
             self.store.export_history(location=self.rollout_logging_dir)
         self.store.clear_history()
         self.make_experience(self.config.method.num_rollouts, self.iter_count)
+
+    # ------------------------------------------------------------------
+    # Low-sync pipelined cycle: one blocking host fetch per PPO iteration
+    # ------------------------------------------------------------------
+
+    def dispatch_rollout_generation(self):
+        """Dispatch generation for the next chunk WITHOUT a host sync.
+        Called right after a train dispatch, the device runs it on the
+        just-updated param handles, so rollouts stay on-policy."""
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        batch = next(self.prompt_iterator)
+        out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
+        return batch, out
+
+    def _build_score_reward_fn(self, scalar_scores: bool):
+        """The score fn PLUS the per-token reward construction in-graph
+        (mirrors _chunk_to_elements' numpy block), so logprobs/values/
+        rewards never round-trip to the host: on relay-tunneled TPU
+        backends every blocking fetch costs a full RTT (~100ms measured
+        here vs ~0.1ms co-located), and the classic cycle pays three per
+        iteration (samples, score outputs, loss). Returns
+        (PPORLBatch chunk on device, mean_kl, mean_kl_per_token)."""
+        model = self.model
+        split = self.split
+        pad_id = self.tokenizer.pad_token_id
+
+        def score_reward(train_params, frozen_params, ref_params,
+                         prompt_tensors, sample_outputs, scores_eff, kl_coef):
+            params = merge_params(train_params, frozen_params)
+            all_tokens = jnp.concatenate([prompt_tensors, sample_outputs], axis=1)
+            attention_mask = (all_tokens != pad_id).astype(jnp.int32)
+            positions = position_ids(attention_mask)
+            logits, values, ref_logits = forward_policy_and_ref(
+                model, params, ref_params, all_tokens, attention_mask, split, positions
+            )
+            logprobs = logprobs_of_labels(logits[:, :-1, :], all_tokens[:, 1:])
+            ref_logprobs = logprobs_of_labels(ref_logits[:, :-1, :], all_tokens[:, 1:])
+            log_ratio = (logprobs - ref_logprobs) * attention_mask[:, :-1]
+            kl = jnp.exp(log_ratio) - 1 - log_ratio
+            mean_kl = kl.sum(1).mean()
+            mean_kl_per_token = kl.mean()
+
+            q = prompt_tensors.shape[1]
+            r = sample_outputs.shape[1]
+            start = q - 1
+            j = jnp.arange(r)[None, :]
+            # degenerate empty responses keep one slot (classic n_resp clamp)
+            n_resp = jnp.maximum((sample_outputs != pad_id).sum(axis=1), 1)[:, None]
+            valid = (j < n_resp).astype(jnp.float32)
+            rewards = (-kl_coef) * log_ratio[:, start:start + r] * valid
+            if scalar_scores:
+                # scalar score lands on the final real token
+                rewards = rewards + (j == n_resp - 1) * scores_eff[:, :1]
+            else:
+                # dense per-token scores, truncated to the response window
+                # (scores_eff is host-prepadded to width r with zeros)
+                rewards = rewards + scores_eff * valid
+            chunk = PPORLBatch(
+                query_tensors=prompt_tensors,
+                response_tensors=sample_outputs,
+                logprobs=logprobs[:, start:start + r] * valid,
+                values=values[:, start:start + r] * valid,
+                rewards=rewards,
+            )
+            return chunk, mean_kl, mean_kl_per_token
+
+        return jax.jit(score_reward)
+
+    def train_epochs_from_chunk(self, chunk: PPORLBatch, n_epochs: int):
+        """All inner epochs' optimizer steps from a DEVICE-resident chunk:
+        per-epoch shuffles are host permutation indices, the stacked
+        [n_steps, batch, ...] batches are gathered on device, and the whole
+        thing runs as the existing one-scan train dispatch. No host copy of
+        the chunk ever exists (the classic path collates through the numpy
+        store)."""
+        n = int(chunk.query_tensors.shape[0])
+        bs = self.config.train.batch_size
+        if n % bs != 0:
+            raise ValueError(f"chunk of {n} rollouts not divisible by batch_size {bs}")
+        steps = n // bs
+        if self._train_step_fn is None:
+            self._build_steps()
+        rng = np.random.default_rng(self.config.train.seed + self.iter_count)
+        idx = np.concatenate(
+            [rng.permutation(n) for _ in range(n_epochs)]
+        ).reshape(n_epochs * steps, bs)
+        stacked = jax.tree_util.tree_map(lambda a: a[jnp.asarray(idx)], chunk)
+        self.train_params, self.opt_state, stats = self._train_scan_fn(
+            self.train_params, self.frozen_params, self.opt_state, stacked
+        )
+        self._normalize_state_shardings()
+        # advance like learn() does per optimizer step — the next cycle's
+        # shuffle seed (and checkpoint naming) must not repeat this one's
+        self.iter_count += n_epochs * steps
+        return stats
+
+    def pipelined_cycle(self, pending=None):
+        """One full PPO iteration — rollouts, scoring, all inner epochs,
+        and the NEXT chunk's generation — with exactly ONE blocking host
+        fetch. The fetch bundles this chunk's samples with the PREVIOUS
+        cycle's loss and mean-KL; the KL controller then updates with the
+        classic cadence (once per inner epoch, between a cycle's training
+        and the next cycle's scoring — reference post_backward_callback,
+        replayed n_inner_epochs times by the fused path).
+        Returns (prev_cycle_loss | None, pending)
+        — pass `pending` back in to continue, and fetch the final cycle's
+        loss from pending[2][0] when done.
+
+        Skips the rollout store / logging (use make_experience + learn for
+        those); causal models only."""
+        if self.seq2seq:
+            raise NotImplementedError("pipelined_cycle covers causal models")
+        method = self.config.method
+        if method.num_rollouts != method.chunk_size:
+            # one cycle == one prompt chunk; a num_rollouts multiple would
+            # silently train on fewer rollouts than configured
+            raise NotImplementedError(
+                f"pipelined_cycle requires num_rollouts == chunk_size "
+                f"(got {method.num_rollouts} vs {method.chunk_size}); "
+                "use make_experience + learn for multi-chunk collections"
+            )
+        max_new = int(
+            (self.generate_experience_kwargs or self.generate_kwargs)
+            .get("max_new_tokens", 40)
+        )
+
+        if pending is None:
+            batch, out = self.dispatch_rollout_generation()
+            pending = (batch, out, None)
+        batch, out, prev = pending
+
+        # The cycle's single blocking fetch.
+        if prev is not None:
+            samples, prev_loss, prev_kl = jax.device_get(
+                (out["samples"], prev[0], prev[1])
+            )
+            self.mean_kl = float(prev_kl)
+            # classic cadence: post_backward_callback fires once per inner
+            # epoch (base_trainer replays it n_inner_epochs times in the
+            # fused path; tests/test_kl_cadence.py)
+            for _ in range(method.ppo_epochs):
+                self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+            prev_loss = float(prev_loss)
+        else:
+            samples = np.asarray(out["samples"])
+            prev_loss = None
+
+        stats: Dict[str, float] = {}
+        prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
+            self._host_process_chunk(batch, samples, stats)
+        )
+
+        scalar = scores.shape[1] == 1
+        if scalar:
+            scores_eff = np.where(scores_mask, scores, 0.0).astype(np.float32)
+        else:
+            scores_eff = np.zeros((len(sample_outputs), max_new), np.float32)
+            w = min(scores.shape[1], max_new)
+            scores_eff[:, :w] = np.where(scores_mask, scores, 0.0)[:, :w]
+
+        fns = getattr(self, "_score_reward_fns", None)
+        if fns is None:
+            fns = self._score_reward_fns = {}
+        if scalar not in fns:
+            fns[scalar] = self._build_score_reward_fn(scalar)
+        chunk, mean_kl, _ = fns[scalar](
+            self.train_params, self.frozen_params, self.ref_params,
+            jnp.asarray(prompt_tensors), jnp.asarray(sample_outputs),
+            jnp.asarray(scores_eff), jnp.float32(self.kl_ctl.value),
+        )
+        stats = self.train_epochs_from_chunk(chunk, method.ppo_epochs)
+
+        nxt_batch, nxt_out = self.dispatch_rollout_generation()
+        handles = (stats["losses"]["total_loss"], mean_kl)
+        return prev_loss, (nxt_batch, nxt_out, handles)
 
     def post_backward_callback(self):
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)

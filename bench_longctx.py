@@ -1,0 +1,122 @@
+"""Long-context training benchmark: single-chip tokens/s + MFU at 8k-16k.
+
+The reference's longest context is 2048 (every shipped NeMo config pins
+encoder_seq_length 2048; Megatron SP only shards activations within a TP
+group — SURVEY.md §5.7), so there is no reference number to normalize
+against: `vs_baseline` is null and the value stands on its own. This
+measures the regime the ring/flash kernels exist for — full fwd+bwd
+language-model training steps (CE over the 50,257 vocab) at GPT-2-small
+shape with `attn_impl="flash"` and per-block rematerialization, where
+attention is the dominant FLOP term (4·L·t·d per token ≈ 2.4× the matmul
+term at t=16k).
+
+Timing follows bench.py's relay discipline: pipelined dispatch of N steps
+with one final host sync (each blocking fetch on this environment's
+tunnel costs ~107ms RTT).
+
+Prints ONE JSON line per sequence length:
+  {"metric": "longctx_train_tokens_per_sec_per_chip", "seq_len": ...,
+   "value": ..., "unit": "tokens/s/chip", "vs_baseline": null,
+   "mfu_estimate": ...}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+from bench import chip_peak_flops
+
+
+def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from trlx_tpu.models import config_from_preset
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.trainer.sft_trainer import causal_lm_ce_loss
+
+    preset = "gpt2-tiny" if smoke else "gpt2-small"
+    vocab = 1024 if smoke else 50257
+    cfg = config_from_preset(
+        preset, vocab_size=vocab, max_seq_len=seq_len,
+        attn_impl="flash", remat_blocks=True,
+    )
+    model = TransformerLM(cfg)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, vocab, size=(batch, seq_len)).astype(np.int32))
+    mask = jnp.ones((batch, seq_len), jnp.int32)
+    params = model.init(
+        jax.random.PRNGKey(0), tokens[:1, :128], mask[:1, :128]
+    )["params"]
+
+    optimizer = optax.adamw(1e-5)
+    opt_state = optimizer.init(params)
+
+    def loss_fn(params, tokens, mask):
+        logits, _, _ = model.apply({"params": params}, tokens, mask)
+        loss, _ = causal_lm_ce_loss(logits, tokens, mask)
+        return loss
+
+    def step(params, opt_state, tokens, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+
+    # warmup (compile) + drain
+    params, opt_state, loss = step(params, opt_state, tokens, mask)
+    _ = float(np.asarray(loss))
+    t0 = time.time()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, tokens, mask)
+    _ = float(np.asarray(loss))
+    elapsed = time.time() - t0
+
+    tokens_per_step = batch * seq_len
+    tps = tokens_per_step * n_steps / elapsed
+
+    # FLOPs/step: fwd = T(L·blk + head) + L·4·(t/2)·d per token;
+    # bwd ≈ 2× fwd (all layers trainable); remat re-runs each block's
+    # forward once more in the backward (+1× the block terms, not the head)
+    d, L, dff, V, t = cfg.d_model, cfg.n_layers, cfg.d_ff, cfg.vocab_size, seq_len
+    blk = 8 * d * d + 4 * d * dff
+    att = 4 * (t / 2) * d
+    head = 2 * d * V
+    fwd = tokens_per_step * (L * (blk + att) + head)
+    remat = tokens_per_step * L * (blk + att)
+    flops_step = 3 * fwd + remat
+    mfu = flops_step * n_steps / elapsed / chip_peak_flops()
+
+    print(json.dumps({
+        "metric": "longctx_train_tokens_per_sec_per_chip",
+        "seq_len": seq_len,
+        "batch": batch,
+        "value": round(tps, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "mfu_estimate": round(mfu, 4),
+    }))
+    sys.stderr.write(
+        f"[bench_longctx] {preset} vocab {vocab} seq {seq_len} batch {batch}: "
+        f"{n_steps} steps in {elapsed:.2f}s, est {flops_step / 1e12:.2f}T/step "
+        f"(attention share {L * att / (L * (blk + att) + head):.0%})\n"
+    )
+    return tps, mfu
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        run(512, 2, n_steps=2, smoke=True)
+        return
+    run(8192, 4)
+    run(16384, 2)
+
+
+if __name__ == "__main__":
+    main()

@@ -248,3 +248,123 @@ def test_fully_masked_query_rows_have_finite_grads():
         lambda q: jnp.sum(context_parallel_attention(runtime.mesh, q, q, q, mask) ** 2)
     )(q)
     assert np.isfinite(np.asarray(g2)).all()
+
+
+# ---------------------------------------------------------------------------
+# Flash backward (FlashAttention-2 from (out, lse) residuals)
+# ---------------------------------------------------------------------------
+
+
+def _dense_attention(q, k, v, mask, causal=True):
+    b, t, nh, hd = q.shape
+    nkv = k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / np.sqrt(hd)
+    allowed = mask[:, None, None, :] > 0
+    if causal:
+        tri = np.tril(np.ones((t, t), bool))
+        allowed = allowed & tri[None, None]
+    s = jnp.where(allowed, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.any(allowed, -1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _grad_case(nkv=None):
+    rng = np.random.default_rng(0)
+    b, t, nh, hd = 2, 64, 4, 16
+    nkv = nkv or nh
+    q = jnp.asarray(rng.normal(size=(b, t, nh, hd)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(b, t, nkv, hd)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(b, t, nkv, hd)).astype(np.float32))
+    mask = np.ones((b, t), np.int32)
+    mask[0, -9:] = 0   # right padding
+    mask[1, :5] = 0    # left padding
+    return q, k, v, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("nkv", [4, 2])
+def test_flash_backward_xla_matches_dense_autodiff(nkv):
+    """The custom blockwise backward (used whenever flash_attention is
+    differentiated off-TPU) == autodiff through dense masked attention,
+    padding and GQA included."""
+    from trlx_tpu.ops.attention import flash_attention
+
+    q, k, v, mask = _grad_case(nkv)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, mask, causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense_attention(q, k, v, mask, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("nkv", [4, 2])
+def test_flash_backward_pallas_interpret_matches_xla(nkv):
+    """The Pallas dq / dkv kernels (interpreter mode) == the XLA blockwise
+    backward on identical residuals."""
+    from trlx_tpu.ops.attention import (
+        _flash_bwd_pallas,
+        _flash_bwd_xla,
+        blockwise_attention_lse,
+    )
+
+    q, k, v, mask = _grad_case(nkv)
+    out, lse = blockwise_attention_lse(q, k, v, mask, causal=True, block_k=32)
+    rng = np.random.default_rng(1)
+    g = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+    dq_p, dk_p, dv_p = _flash_bwd_pallas(q, k, v, mask, out, lse, g,
+                                         True, 32, 32, interpret=True)
+    dq_x, dk_x, dv_x = _flash_bwd_xla(q, k, v, mask, out, lse, g, True, 32)
+    np.testing.assert_allclose(np.asarray(dq_p), np.asarray(dq_x), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk_p), np.asarray(dk_x), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv_p), np.asarray(dv_x), atol=1e-4)
+
+
+def test_flash_fwd_lse_kernel_interpret():
+    """The LSE-emitting forward kernel == blockwise forward + its LSE,
+    dead (fully-masked) rows included."""
+    from trlx_tpu.ops.attention import (
+        _flash_fwd_pallas_lse,
+        blockwise_attention_lse,
+    )
+
+    q, k, v, mask = _grad_case()
+    mask = mask.at[1, :].set(0)  # a fully-masked row
+    out_p, lse_p = _flash_fwd_pallas_lse(q, k, v, mask, True, 32, 32,
+                                         interpret=True)
+    out_b, lse_b = blockwise_attention_lse(q, k, v, mask, causal=True, block_k=32)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse_p), np.asarray(lse_b), atol=1e-4)
+
+
+def test_flash_backward_memory_is_not_quadratic():
+    """Compile-time memory analysis: the backward of a long-sequence flash
+    forward must not bank O(t^2) residuals (the old recompute-by-vjp did,
+    and OOMed real training at seq 8192)."""
+    from trlx_tpu.ops.attention import flash_attention
+
+    b, t, nh, hd = 1, 4096, 2, 16
+    q = jnp.zeros((b, t, nh, hd), jnp.float32)
+    mask = jnp.ones((b, t), jnp.int32)
+
+    def loss(q):
+        return (flash_attention(q, q, q, mask, causal=True) ** 2).sum()
+
+    compiled = jax.jit(jax.grad(loss)).lower(q).compile()
+    analysis = compiled.memory_analysis()
+    if analysis is None:
+        pytest.skip("backend exposes no memory analysis")
+    total = analysis.temp_size_in_bytes
+    # O(t^2) in f32 would be >= t*t*4 = 64MB per head; linear-in-t buffers
+    # at these shapes stay far below
+    assert total < t * t * 4, f"backward temps look quadratic: {total}"

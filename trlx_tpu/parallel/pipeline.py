@@ -99,16 +99,18 @@ def make_pipe_mesh(
     return Mesh(arr, ("data", PIPE_AXIS, "fsdp", "tensor", "sequence"))
 
 
-def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+def partial_shard_map(fn, mesh: Mesh, in_specs, out_specs, compute_dtype=None):
     """GPipe's shard_map: manual over ("data", "pipe", "sequence");
     fsdp/tensor stay GSPMD-auto (see trlx_tpu/parallel/context.py
-    partial_shard_map for the mechanism and the XLA:CPU bf16 caveat).
-    "sequence" is intersected with the mesh's axes, so meshes without a
-    sequence axis are unaffected."""
+    partial_shard_map for the mechanism and the XLA:CPU bf16 caveat —
+    `compute_dtype` feeds its bf16-on-CPU guard). "sequence" is
+    intersected with the mesh's axes, so meshes without a sequence axis
+    are unaffected."""
     from trlx_tpu.parallel.context import partial_shard_map as _psm
 
     return _psm(fn, mesh, in_specs, out_specs,
-                manual={"data", PIPE_AXIS, "sequence"})
+                manual={"data", PIPE_AXIS, "sequence"},
+                compute_dtype=compute_dtype)
 
 
 def stacked_param_shardings(mesh: Mesh, stacked, n_lead: int, rules=None):
@@ -247,7 +249,7 @@ def gpipe_blocks(
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
 
     def tick(carry, r):
-        recv_h, recv_mask, recv_pos, out = carry
+        recv_h, recv_mask, recv_pos = carry
         r_in = jnp.clip(r, 0, M - 1)
         mb_h = jax.lax.dynamic_index_in_dim(h_mbs, r_in, 0, keepdims=False)
         mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, r_in, 0, keepdims=False)
@@ -257,28 +259,26 @@ def gpipe_blocks(
         pos = jnp.where(idx == 0, mb_pos, recv_pos)
         y = stage(x, mask, pos)
 
-        write_idx = jnp.clip(r - (S - 1), 0, M - 1)
-        banked = jax.lax.dynamic_update_index_in_dim(out, y, write_idx, 0)
-        out = jnp.where((r >= S - 1) & (idx == S - 1), banked, out)
-
         next_h, next_mask, next_pos = jax.lax.ppermute(
             (y, mask, pos), axis_name, fwd_perm
         )
-        return (next_h, next_mask, next_pos, out), None
+        # y rides the scan OUTPUT (ys), not the carry: a carry-borne bank
+        # is saved by the scan's backward at EVERY tick — O(M^2)
+        # activation residuals — while ys are written once, keeping the
+        # bank O(M) (tests/test_pipeline_memory.py pins the bound)
+        return (next_h, next_mask, next_pos), y
 
-    # Derive the output bank from `h` (not a fresh jnp.zeros) so it carries
-    # h's varying-axis type (e.g. "data" in DP x PP hybrids) — the scan carry
-    # must type-match the stage outputs it accumulates.
-    out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
         (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]),
-         jnp.zeros_like(pos_mbs[0]), out0),
+         jnp.zeros_like(pos_mbs[0])),
     )
-    (_, _, _, out), _ = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
+    _, ys = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
 
-    # Broadcast the finished activations from the last stage to all stages
-    # (mask-and-psum; one collective, lets unembed/loss run replicated).
+    # Microbatch m finishes on the LAST stage at tick m + S - 1; broadcast
+    # those activations to all stages (mask-and-psum; one collective, lets
+    # unembed/loss run replicated).
+    out = ys[S - 1:]
     out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
     return out.reshape(B, t, d)
 
@@ -380,7 +380,7 @@ def interleaved_blocks(
     n_ticks = t_last + span
 
     def tick(carry, r):
-        recv_h, recv_mask, recv_pos, out = carry
+        recv_h, recv_mask, recv_pos = carry
         base = (r - idx) % S
         w = (r - base) // span
         q = r - base - w * span  # ticks since this mb entered stage 0
@@ -404,23 +404,25 @@ def interleaved_blocks(
         )
         y = stage(chunk, x, mask, pos, loop_in)
 
-        bank_now = valid & (idx == S - 1) & (loop == v - 1)
-        banked = jax.lax.dynamic_update_index_in_dim(out, y, m_in, 0)
-        out = jnp.where(bank_now, banked, out)
-
         next_h, next_mask, next_pos = jax.lax.ppermute(
             (y, mask, pos), axis_name, ring_perm
         )
-        return (next_h, next_mask, next_pos, out), None
+        # bank via scan OUTPUT, not carry (see gpipe_blocks)
+        return (next_h, next_mask, next_pos), y
 
-    out0 = jnp.zeros_like(h).reshape(M, mb, t, d)
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
         (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]),
-         jnp.zeros_like(pos_mbs[0]), out0),
+         jnp.zeros_like(pos_mbs[0])),
     )
-    (_, _, _, out), _ = jax.lax.scan(tick, init, jnp.arange(n_ticks))
+    _, ys = jax.lax.scan(tick, init, jnp.arange(n_ticks))
 
+    # Microbatch m enters stage 0 at (m mod S) + (m div S)·S·v and the
+    # last device finishes its loop v-1 exactly S·v - 1 ticks later.
+    finish = np.asarray(
+        [(m % S) + (m // S) * span + span - 1 for m in range(M)], np.int32
+    )
+    out = jnp.take(ys, jnp.asarray(finish), axis=0)
     out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
     return out.reshape(B, t, d)
 
@@ -478,6 +480,7 @@ def make_gpipe_forward_stacked(
         mesh,
         in_specs=(P(PIPE_AXIS), P(), b_spec, b_spec, b_spec),
         out_specs=out_spec,
+        compute_dtype=cfg.dtype,
     )
 
     def fwd(stacked, rest, tokens, attn_mask):

@@ -100,6 +100,7 @@ class SequenceParallelILQLTrainer(ILQLTrainer):
             in_specs=(P(), spec, spec, spec),
             out_specs=(spec, spec),
             manual={"data", "sequence"},
+            compute_dtype=self.model_cfg.dtype,
         )
 
         def loss_fn(train_params, frozen_params, batch):

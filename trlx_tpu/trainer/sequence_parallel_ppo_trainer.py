@@ -129,6 +129,7 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             in_specs=(P(), spec, spec, spec, spec),
             out_specs=(spec, spec),
             manual={"data", "sequence"},
+            compute_dtype=self.model_cfg.dtype,
         )
 
         def loss_fn(train_params, frozen_params, batch):
@@ -189,6 +190,7 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             in_specs=(P(), P(), spec, spec, spec, spec),
             out_specs=(spec, spec, spec),
             manual={"data", "sequence"},
+            compute_dtype=self.model_cfg.dtype,
         )
 
         def score(train_params, frozen_params, ref_params, all_tokens):

@@ -141,6 +141,7 @@ class SequenceParallelSFTTrainer(SFTTrainer):
             in_specs=(P(), batch_spec, batch_spec, batch_spec, batch_spec),
             out_specs=(P(), P()),
             manual={"data", "sequence"},
+            compute_dtype=self.model_cfg.dtype,
         )
 
         def loss_fn(train_params, frozen_params, batch):

@@ -26,7 +26,7 @@ sweep.yml:
     tune_config:
         mode: max
         metric: reward/mean
-        search_alg: random        # random | grid
+        search_alg: random        # random | grid | tpe (model-based)
         num_samples: 8            # trials (ignored for grid)
         num_workers: 2            # concurrent trial slots (default 1)
         worker_env:               # optional per-slot env overlays
@@ -47,7 +47,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import yaml
@@ -109,14 +109,190 @@ def sample_trials(
         return enumerate_grid(param_space)
     if search_alg != "random":
         raise ValueError(
-            f"search_alg '{search_alg}' unsupported (random | grid; the "
-            "reference's bayesopt/bohb need external packages)"
+            f"search_alg '{search_alg}' unsupported here (random | grid); "
+            "model-based search goes through make_searcher"
         )
     rng = np.random.default_rng(seed)
     return [
         {k: sample_strategy(v, rng) for k, v in param_space.items()}
         for _ in range(num_samples)
     ]
+
+
+# ---------------------------------------------------------------------------
+# Searchers (the reference's Ray Tune search_alg role, sweep.py:103-130 —
+# bayesopt/BOHB there; TPE here, dependency-free)
+# ---------------------------------------------------------------------------
+
+
+class RandomSearcher:
+    """suggest() ~ the prior; observations ignored."""
+
+    def __init__(self, param_space: Dict[str, Dict], num_samples: int, seed: int = 0):
+        self.space = param_space
+        self.num_samples = num_samples
+        self.rng = np.random.default_rng(seed)
+
+    def suggest(self) -> Dict[str, Any]:
+        return {k: sample_strategy(v, self.rng) for k, v in self.space.items()}
+
+    def observe(self, hparams: Dict[str, Any], score: float) -> None:
+        pass
+
+
+class GridSearcher:
+    def __init__(self, param_space: Dict[str, Dict]):
+        self.trials = enumerate_grid(param_space)
+        self.num_samples = len(self.trials)
+        self._i = 0
+
+    def suggest(self) -> Dict[str, Any]:
+        t = self.trials[self._i % len(self.trials)]
+        self._i += 1
+        return t
+
+    def observe(self, hparams: Dict[str, Any], score: float) -> None:
+        pass
+
+
+_LOG_STRATEGIES = ("loguniform", "qloguniform", "lograndint")
+_INT_STRATEGIES = ("randint", "qrandint", "lograndint")
+
+
+class TPESearcher:
+    """Tree-structured Parzen Estimator (Bergstra et al. 2011), per-dim
+    independent — the standard Hyperopt formulation, ~100 lines and no
+    external packages (the reference reaches for Ray's bayesopt/BOHB,
+    trlx/sweep.py:103-130). Completed trials split into a good (top
+    `gamma` quantile) and bad set; each continuous dim gets a Gaussian
+    KDE per set (log-space for log strategies), each categorical dim a
+    Laplace-smoothed histogram; candidates drawn from the good model are
+    ranked by the density ratio g(x)/b(x). Until `n_startup` observations
+    it falls back to prior sampling. Maximizes `score` — run_sweep
+    negates for mode=min. Robust to concurrency: suggest() just uses
+    whatever observations exist."""
+
+    def __init__(self, param_space: Dict[str, Dict], num_samples: int,
+                 seed: int = 0, gamma: float = 0.25, n_candidates: int = 24,
+                 n_startup: Optional[int] = None):
+        self.space = param_space
+        self.num_samples = num_samples
+        self.rng = np.random.default_rng(seed)
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.n_startup = (
+            n_startup if n_startup is not None else max(4, num_samples // 4)
+        )
+        self.obs: List[tuple] = []  # (hparams, score)
+
+    def observe(self, hparams: Dict[str, Any], score: float) -> None:
+        if np.isfinite(score):
+            self.obs.append((hparams, float(score)))
+
+    def suggest(self) -> Dict[str, Any]:
+        if len(self.obs) < self.n_startup:
+            return {k: sample_strategy(v, self.rng) for k, v in self.space.items()}
+        ranked = sorted(self.obs, key=lambda o: o[1], reverse=True)
+        n_good = max(1, int(np.ceil(self.gamma * len(ranked))))
+        good = [h for h, _ in ranked[:n_good]]
+        bad = [h for h, _ in ranked[n_good:]] or good
+        return {
+            k: self._suggest_dim(k, spec, good, bad)
+            for k, spec in self.space.items()
+        }
+
+    def _suggest_dim(self, key: str, spec: Dict, good: List[Dict], bad: List[Dict]):
+        strategy, values = spec["strategy"], spec["values"]
+        if strategy in ("choice", "grid", "grid_search"):
+            def pdf(v, group):
+                hits = sum(1 for h in group if h[key] == v)
+                return (hits + 1.0) / (len(group) + len(values))
+
+            gv = [h[key] for h in good]
+            best = max(values, key=lambda v: pdf(v, good) / pdf(v, bad))
+            # exploration: an rng draw from the good histogram half the time
+            if gv and self.rng.random() < 0.5:
+                return gv[self.rng.integers(len(gv))]
+            return best
+
+        log = strategy in _LOG_STRATEGIES
+        to_x = (lambda v: np.log(v)) if log else (lambda v: float(v))
+        from_x = (lambda x: float(np.exp(x))) if log else (lambda x: float(x))
+        if strategy in ("randn", "qrandn"):
+            mean, sd = values[:2]
+            lo, hi = mean - 4 * sd, mean + 4 * sd
+        elif strategy in _INT_STRATEGIES:
+            # the prior (rng.integers / exp-uniform int) treats the upper
+            # bound as EXCLUSIVE — clip suggestions to values[1] - 1 so
+            # TPE can never propose an out-of-space integer
+            lo, hi = to_x(values[0]), to_x(max(values[1] - 1, values[0]))
+        else:
+            lo, hi = to_x(values[0]), to_x(values[1])
+        g = np.asarray([to_x(h[key]) for h in good])
+        b = np.asarray([to_x(h[key]) for h in bad])
+        span = max(hi - lo, 1e-12)
+
+        def per_point_bw(xs):
+            # Hyperopt's heuristic: each kernel's width is the distance to
+            # its nearest sorted neighbors — wide in sparse regions
+            # (exploration), narrow in dense ones (exploitation)
+            if len(xs) == 1:
+                return np.asarray([span])
+            order = np.argsort(xs)
+            d = np.diff(xs[order])
+            widths = np.maximum(
+                np.concatenate([d[:1], d]), np.concatenate([d, d[-1:]])
+            )
+            bw = np.empty_like(widths)
+            bw[order] = widths
+            # adaptive floor: near-duplicate observations must not collapse
+            # their kernels (an exploitation death spiral — every candidate
+            # lands on the same point); shrink the floor only as real
+            # coverage grows
+            return np.clip(bw, span / (2.0 * len(xs)), span)
+
+        bw_g, bw_b = per_point_bw(g), per_point_bw(b)
+        # candidates: mostly good-KDE draws, a quarter from the prior so a
+        # lucky early cluster cannot lock the search out of better basins
+        n_prior = max(1, self.n_candidates // 4)
+        ci = self.rng.integers(len(g), size=self.n_candidates - n_prior)
+        cand = np.concatenate([
+            np.clip(g[ci] + self.rng.normal(0, 1, len(ci)) * bw_g[ci], lo, hi),
+            self.rng.uniform(lo, hi, n_prior),
+        ])
+
+        def density(xs, bw, x):
+            # KDE mixed with the uniform prior as one pseudo-component
+            # (Hyperopt's formulation): nonzero tails everywhere, so
+            # prior-drawn candidates compete on real density ratios
+            kde = (
+                np.exp(-0.5 * ((x[:, None] - xs[None, :]) / bw[None, :]) ** 2)
+                / (bw[None, :] * np.sqrt(2 * np.pi))
+            ).sum(1)
+            return (kde + 1.0 / span) / (len(xs) + 1)
+
+        ratio = density(g, bw_g, cand) / density(b, bw_b, cand)
+        x = float(cand[int(np.argmax(ratio))])
+        v = from_x(x)
+        if strategy in ("quniform", "qloguniform", "qrandn", "qrandint"):
+            q = values[2]
+            v = float(np.round(v / q) * q)
+        if strategy in _INT_STRATEGIES:
+            v = int(np.round(v))
+        return v
+
+
+def make_searcher(param_space: Dict[str, Dict], search_alg: str,
+                  num_samples: int, seed: int = 0):
+    if search_alg in ("grid", "grid_search"):
+        return GridSearcher(param_space)
+    if search_alg == "random":
+        return RandomSearcher(param_space, num_samples, seed)
+    if search_alg == "tpe":
+        return TPESearcher(param_space, num_samples, seed)
+    raise ValueError(
+        f"search_alg '{search_alg}' unsupported (random | grid | tpe)"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -175,12 +351,12 @@ def run_sweep(
     tune_config = dict(config.pop("tune_config"))
     metric = tune_config["metric"]
     mode = tune_config.get("mode", "max")
-    trials = sample_trials(
-        config,
-        tune_config.get("search_alg", "random"),
-        int(tune_config.get("num_samples", 8)),
-        seed=seed,
+    search_alg = tune_config.get("search_alg", "random")
+    searcher = make_searcher(
+        config, search_alg, int(tune_config.get("num_samples", 8)), seed=seed
     )
+    n_trials = searcher.num_samples
+    sign = 1.0 if mode == "max" else -1.0  # searchers maximize
 
     if num_workers is None:
         num_workers = int(tune_config.get("num_workers", 1))
@@ -191,7 +367,7 @@ def run_sweep(
     sweep_dir = os.path.join(output_dir, f"sweep-{stamp}")
     os.makedirs(sweep_dir, exist_ok=True)
     logger.info(
-        f"Sweep: {len(trials)} trials of {script} -> {sweep_dir} "
+        f"Sweep: {n_trials} trials ({search_alg}) of {script} -> {sweep_dir} "
         f"({num_workers} worker slot(s))"
     )
 
@@ -202,20 +378,23 @@ def run_sweep(
     # env at a different slice — e.g. TPU_VISIBLE_DEVICES, or
     # COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID for remote launchers).
     # num_workers=1 is the single-chip default: a chip is one exclusive
-    # device, so concurrent local trials would only contend.
+    # device, so concurrent local trials would only contend. Trials are
+    # PROPOSED lazily so a model-based searcher (tpe) conditions each
+    # suggestion on every completed observation.
     results = []
-    pending = list(enumerate(trials))[::-1]  # pop() from the front
+    launched = 0
     running: Dict[int, Any] = {}  # slot -> (i, hparams, proc, out, trial_dir)
     try:
-        while pending or running:
-            while pending and len(running) < num_workers:
+        while launched < n_trials or running:
+            while launched < n_trials and len(running) < num_workers:
                 slot = next(s for s in range(num_workers) if s not in running)
-                i, hparams = pending.pop()
+                i, hparams = launched, searcher.suggest()
+                launched += 1
                 trial_dir = os.path.join(sweep_dir, f"trial_{i:03d}")
                 trial_env = dict(env) if env is not None else dict(os.environ)
                 if slot < len(worker_env):
                     trial_env.update({k: str(v) for k, v in worker_env[slot].items()})
-                logger.info(f"[trial {i + 1}/{len(trials)} @ slot {slot}] {hparams}")
+                logger.info(f"[trial {i + 1}/{n_trials} @ slot {slot}] {hparams}")
                 proc, out = launch_trial(script, hparams, trial_dir, env=trial_env)
                 running[slot] = (i, hparams, proc, out, trial_dir)
             for slot in list(running):
@@ -226,10 +405,11 @@ def run_sweep(
                 out.close()
                 del running[slot]
                 score = read_metric(trial_dir, metric, mode)
+                searcher.observe(hparams, sign * score)
                 results.append({
                     "trial": i, "hparams": hparams, "returncode": code, metric: score,
                 })
-                logger.info(f"[trial {i + 1}/{len(trials)}] {metric} = {score}")
+                logger.info(f"[trial {i + 1}/{n_trials}] {metric} = {score}")
             if running:
                 time.sleep(0.5)
     finally:
@@ -253,14 +433,92 @@ def run_sweep(
         "script": script,
         "metric": metric,
         "mode": mode,
+        "search_alg": search_alg,
         "best": ranked[0] if ranked else None,
         "results": ranked,
     }
     with open(os.path.join(sweep_dir, "sweep_results.json"), "w") as f:
         json.dump(summary, f, indent=2)
+    write_report(sweep_dir, summary, config, results)
 
     _print_table(ranked, metric)
     return summary
+
+
+def write_report(sweep_dir: str, summary: Dict[str, Any],
+                 param_space: Dict[str, Dict], results: List[Dict]) -> str:
+    """Self-contained markdown sweep report (the reference ends its sweeps
+    with a W&B report built by create_report, trlx/sweep.py:222-265; this
+    one needs no service): header, best trial, ranked table,
+    incremental-best curve, and a per-parameter analysis comparing the
+    top-quartile trials' parameter range against the searched space."""
+    metric, mode = summary["metric"], summary["mode"]
+    ranked = summary["results"]
+    lines = [
+        f"# Sweep report — `{os.path.basename(summary['script'])}`",
+        "",
+        f"- metric: **{metric}** ({mode})",
+        f"- search: {summary['search_alg']}, {len(results)} trials",
+        f"- generated: {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        "",
+        "## Best trial",
+        "",
+    ]
+    if summary["best"]:
+        best = summary["best"]
+        lines += [
+            f"`{metric} = {best[metric]:.6g}` (trial {best['trial']})",
+            "",
+            "```json",
+            json.dumps(best["hparams"], indent=2),
+            "```",
+            "",
+        ]
+    lines += ["## Ranked trials", "",
+              f"| rank | trial | {metric} | hparams |",
+              "|---|---|---|---|"]
+    for rank, r in enumerate(ranked[:20]):
+        lines.append(
+            f"| {rank} | {r['trial']} | {r[metric]:.6g} | "
+            f"`{json.dumps(r['hparams'])}` |"
+        )
+
+    # incremental best over launch order
+    lines += ["", "## Incremental best", "", "| trial | best so far |", "|---|---|"]
+    by_launch = sorted(results, key=lambda r: r["trial"])
+    best_so_far = None
+    better = (lambda a, b: a > b) if mode == "max" else (lambda a, b: a < b)
+    for r in by_launch:
+        v = r[metric]
+        if np.isfinite(v) and (best_so_far is None or better(v, best_so_far)):
+            best_so_far = v
+        lines.append(f"| {r['trial']} | {best_so_far if best_so_far is not None else '—'} |")
+
+    # per-parameter: top-quartile range vs searched space
+    n_top = max(1, len(ranked) // 4)
+    top = ranked[:n_top]
+    lines += ["", f"## Parameter analysis (top {n_top} trial(s))", "",
+              "| param | strategy | searched | top-quartile |",
+              "|---|---|---|---|"]
+    for key, spec in param_space.items():
+        vals = [r["hparams"][key] for r in top if key in r["hparams"]]
+        if not vals:
+            continue
+        if spec["strategy"] in ("choice", "grid", "grid_search"):
+            from collections import Counter
+
+            counts = Counter(vals)
+            desc = ", ".join(f"{v}×{c}" for v, c in counts.most_common())
+        else:
+            desc = f"[{min(vals):.4g}, {max(vals):.4g}]"
+        lines.append(
+            f"| `{key}` | {spec['strategy']} | `{spec['values']}` | {desc} |"
+        )
+    path = os.path.join(sweep_dir, "sweep_report.md")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    logger.info(f"Sweep report: {path}")
+    return path
 
 
 def _print_table(ranked: List[Dict], metric: str, max_rows: int = 20):

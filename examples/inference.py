@@ -1,0 +1,125 @@
+"""Load a trained checkpoint and talk to it.
+
+The role of the reference's examples/nemo_ppo_inference.py /
+nemo_ilql_inference.py (load a trained checkpoint, batch or interactive
+generation — including ILQL's Q-guided decode) for self-contained
+`save_pretrained` exports:
+
+    # plain sampling / beam search over an HF-layout export
+    python examples/inference.py '{"checkpoint": "ckpts/hf_model"}'
+    python examples/inference.py '{"checkpoint": "ckpts/hf_model", "mode": "beam"}'
+
+    # ILQL: base weights from the export, Q/V heads restored from the
+    # orbax trainer checkpoint, decode reweighted by beta*(Q - V)
+    python examples/inference.py '{"checkpoint": "...", "mode": "ilql",
+                                   "resume": "ckpts/checkpoint_100"}'
+
+    # REPL
+    python examples/inference.py '{"checkpoint": "...", "interactive": true}'
+
+Any other dotted TRLConfig key in the hparams JSON overrides the config
+(same contract as every example script).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+
+def build_trainer(checkpoint: str, mode: str, resume=None, tokenizer="byte",
+                  hparams=None):
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.data.default_configs import default_ilql_config, default_sft_config
+
+    base = default_ilql_config() if mode == "ilql" else default_sft_config()
+    config = base.evolve(
+        model=dict(model_path=checkpoint),
+        tokenizer=dict(tokenizer_path=tokenizer),
+        train=dict(total_steps=0, tracker=None,
+                   checkpoint_dir=os.path.join(checkpoint, "_inference_ckpt")),
+    )
+    if hparams:
+        config = TRLConfig.update(config, hparams)
+
+    if mode == "ilql":
+        from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+
+        trainer = ILQLTrainer(config)
+    else:
+        from trlx_tpu.trainer.sft_trainer import SFTTrainer
+
+        trainer = SFTTrainer(config)
+    if resume:
+        # restores the full trainer state — incl. the ILQL Q/V heads the
+        # HF export has no slot for
+        trainer.load(resume)
+    return trainer
+
+
+def generate_table(trainer, prompts, mode: str, gen_kwargs):
+    tok = trainer.tokenizer
+    rows = [tok.encode(p)[-trainer.config.train.seq_length // 2:] for p in prompts]
+    width = max(len(r) for r in rows)
+    pad = tok.pad_token_id
+    ids = np.full((len(rows), width), pad, np.int32)
+    mask = np.zeros_like(ids)
+    for i, r in enumerate(rows):  # left-padded prompts (decode convention)
+        ids[i, width - len(r):] = r
+        mask[i, width - len(r):] = 1
+    out = trainer.generate(ids, mask, gen_kwargs,
+                           mode="ilql" if mode == "ilql" else "lm")
+    samples = np.asarray(out["samples"])
+    _, _, outputs = trainer.decode(ids, samples, [width] * len(rows))
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table("prompt", "output", title=f"inference ({mode})")
+        for p, o in zip(prompts, outputs):
+            table.add_row(p, o)
+        Console().print(table)
+    except ImportError:
+        for p, o in zip(prompts, outputs):
+            print(f"{p!r} -> {o!r}")
+    return outputs
+
+
+def main(hparams=None):
+    hparams = dict(hparams if hparams is not None else
+                   (json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}))
+    checkpoint = hparams.pop("checkpoint")
+    mode = hparams.pop("mode", "sample")  # sample | beam | ilql
+    resume = hparams.pop("resume", None)
+    prompts = hparams.pop("prompts", ["hello ", "the quick ", "once upon "])
+    interactive = hparams.pop("interactive", False)
+    max_new = int(hparams.pop("max_new_tokens", 16))
+    tokenizer = hparams.pop("tokenizer", "byte")
+
+    if mode not in ("sample", "beam", "ilql"):
+        raise ValueError(f"mode must be sample | beam | ilql, got {mode!r}")
+    trainer = build_trainer(checkpoint, mode, resume, tokenizer, hparams)
+
+    gen_kwargs = dict(max_new_tokens=max_new)
+    if mode == "beam":
+        gen_kwargs.update(num_beams=4, do_sample=False)
+    else:  # sampling; ILQL additionally shifts logits by beta*(Q - V)
+        gen_kwargs.update(do_sample=True, top_k=0, top_p=1.0, temperature=1.0)
+
+    if interactive:
+        print("prompt> ", end="", flush=True)
+        for line in sys.stdin:
+            line = line.rstrip("\n")
+            if not line:
+                break
+            generate_table(trainer, [line], mode, gen_kwargs)
+            print("prompt> ", end="", flush=True)
+        return None
+    return generate_table(trainer, prompts, mode, gen_kwargs)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""examples/inference.py — save -> load -> generate round trips (VERDICT
+r2 missing #5: the role of the reference's nemo_ppo_inference.py /
+nemo_ilql_inference.py: load the artifact you trained and talk to it)."""
+
+import importlib
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import trlx_tpu as trlx
+from trlx_tpu.data.default_configs import default_ilql_config, default_sft_config
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(scope="module")
+def inference():
+    return importlib.import_module("examples.inference")
+
+
+def _common(tmp, trainer_name, base):
+    return base.evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, total_steps=2, tracker=None,
+                   eval_interval=10, checkpoint_interval=100, trainer=trainer_name,
+                   checkpoint_dir=str(tmp), seed=5),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+    )
+
+
+def test_sft_save_load_generate(tmp_path, inference):
+    config = _common(tmp_path, "SFTTrainer", default_sft_config())
+    trainer = trlx.train(samples=["hello world text", "more sample data"] * 4,
+                         eval_prompts=["hello"], config=config)
+    export = str(tmp_path / "hf_model")
+    trainer.save_pretrained(export)
+
+    for mode in ("sample", "beam"):
+        outputs = inference.main({
+            "checkpoint": export, "mode": mode, "max_new_tokens": 4,
+            "prompts": ["hello ", "more "],
+            "train.seq_length": 32,
+        })
+        assert len(outputs) == 2
+        assert all(isinstance(o, str) for o in outputs)
+
+
+def test_ilql_save_load_qguided_generate(tmp_path, inference):
+    config = _common(tmp_path, "ILQLTrainer", default_ilql_config())
+    trainer = trlx.train(
+        samples=["good sample", "also good", "bad one", "fine text"] * 2,
+        rewards=[1.0, 0.8, -1.0, 0.5] * 2,
+        eval_prompts=["good"], config=config,
+    )
+    export = str(tmp_path / "hf_model")
+    trainer.save_pretrained(export)
+    state_dir = str(tmp_path / "state_ckpt")
+    trainer.save(state_dir)
+
+    outputs = inference.main({
+        "checkpoint": export, "mode": "ilql", "resume": state_dir,
+        "max_new_tokens": 4, "prompts": ["good "],
+        "train.seq_length": 32,
+    })
+    assert len(outputs) == 1 and isinstance(outputs[0], str)

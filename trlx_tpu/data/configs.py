@@ -140,6 +140,14 @@ class ParallelConfig:
     # the pipeline bubble by ~1/pipeline_interleave at the cost of more
     # ring hops — megatron's virtual PP)
     pipeline_interleave: int = 1
+    # microbatch schedule for the pipelined trainers' TRAIN step:
+    # "gpipe" (default) = all-forward-then-autodiff-backward, loss computed
+    # on the full banked logits; "1f1b" = the hand-scheduled one-forward-
+    # one-backward engine (parallel/onef1b.py) with per-microbatch in-pipe
+    # loss — activation residency bounded by ~2*pipeline microbatches and
+    # no [batch, seq, vocab] logits bank (the reference Apex engine's
+    # memory behavior, modeling_nemo_ppo.py:713-731)
+    pipeline_schedule: str = "gpipe"
     # multi-slice scale-out: number of DCN-connected slices, folded into the
     # data axis so only data-parallel gradient reductions cross DCN
     dcn_data: int = 1

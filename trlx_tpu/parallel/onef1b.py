@@ -1,0 +1,328 @@
+"""True 1F1B pipeline schedule: hand-scheduled value-and-grad with in-pipe
+per-microbatch loss.
+
+The GPipe-by-autodiff engine (trlx_tpu/parallel/pipeline.py) returns the
+FULL batch's logits to the caller, which computes the loss outside the
+pipeline program. That is simple and its backward falls out of autodiff,
+but it banks two O(global-batch) artifacts per step: the [B, t, d]
+final-stage activation bank (the scan's ys) and — far larger — the
+[B, t, V] logits the loss consumes (13 GB at B=64, t=1024, V=50k in f32).
+The reference's Apex 1F1B engine has neither: each microbatch's loss and
+backward run as soon as its forward finishes, so at most O(S) microbatches
+of activations are ever live and logits only ever exist per-microbatch
+(reference modeling_nemo_ppo.py:713-731 — get_forward_backward_func with
+forward_only=False interleaves fwd/bwd per microbatch).
+
+This module is the TPU-native equivalent: ONE shard_map program whose tick
+scan runs the eager-1F1B schedule
+
+    forward  of microbatch f at stage i on tick  t_F(f, i) = f + i
+    backward of microbatch b at stage i on tick  t_B(b, i) = b + 2S - 2 - i
+
+so the last stage (i = S-1) runs a microbatch's loss + backward on the
+SAME tick as its forward, and the backward wavefront climbs the pipeline
+one stage per tick, exactly S-1 ticks behind the forward wavefront's
+departure. Every stage does one forward and one backward per tick in
+steady state (no parity holes — adjacent ranks are served by the same
+tick via the down/up ppermute pair), and the in-flight window at stage i
+is 2(S - i) - 1 microbatches, bounded by 2S - 1 *independent of M*.
+
+Because the schedule is hand-written, so is the backward: each stage
+stashes only its INPUT activation per in-flight microbatch (a ring buffer
+of min(2S-1, M) slots) and the backward tick recomputes the stage forward
+under `jax.vjp` — the same recompute cost autodiff-with-remat pays, but
+with residual lifetime bounded by the schedule instead of the scan.
+Gradients accumulate in the scan carry; the final psum over ("data",
+"pipe") replaces the transpose-inserted collectives of the autodiff path.
+
+There is no NCCL/MPI or Apex machinery to port: the schedule is pure
+`lax.scan` + two `ppermute`s per tick, and XLA overlaps the permutes with
+the next tick's compute. fsdp/tensor mesh axes stay GSPMD-auto, so the
+stage matmuls and their vjps shard exactly as in the GPipe engine.
+"""
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+from trlx_tpu.models.transformer import TransformerConfig, position_ids, train_bias
+from trlx_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    _apply_layer_stack,
+    partial_shard_map,
+)
+
+GRAD_AXES = ("data", PIPE_AXIS)
+
+
+def _vary(x):
+    """Mark a value as device-varying over the manual axes (jax VMA
+    types). Correctness of the whole engine depends on this NOT being a
+    no-op — see the CRITICAL note in make_1f1b_grad_fn: an invariant
+    input to jax.vjp gets its cotangent implicitly psummed over the
+    manual axes, which would corrupt gradients. So unlike pipeline.py's
+    forward-only `_varying` (where skipping is benign), a jax without
+    pcast/VMA refuses loudly instead of silently training wrong."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        raise NotImplementedError(
+            "the 1F1B engine requires jax.lax.pcast (VMA-typed shard_map); "
+            "this jax version lacks it — use pipeline_schedule='gpipe'"
+        )
+    have = getattr(getattr(x, "aval", None), "vma", None) or frozenset()
+    missing = tuple(ax for ax in GRAD_AXES if ax not in have)
+    return pcast(x, missing, to="varying") if missing else x
+
+
+def default_finalize(tick_stats, gate, ctx):
+    """Sum-decomposed stats: every leaf is a per-microbatch SUM contribution;
+    the final stat is the global sum (pipe+data psum of the gated tick sums).
+    Losses normalized inside loss_mb (divide by a ctx-borne global count)
+    therefore come out exactly equal to the batch-level computation."""
+    del ctx
+
+    def _one(leaf):
+        return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
+
+    return jax.tree_util.tree_map(_one, tick_stats)
+
+
+def make_1f1b_grad_fn(
+    model,  # TransformerLM (definitions are pure; only embed/unembed used here)
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    n_microbatches: int,
+    loss_mb: Callable,  # (rest, heads, h, tok_mb, mask_mb, mb_batch, ctx) -> (loss_contrib, tick_stats)
+    ctx_fn: Optional[Callable] = None,  # (tokens, attn_mask, batch) -> ctx; runs INSIDE shard_map
+    finalize_fn: Callable = default_finalize,  # (tick_stats[n_ticks], gate[n_ticks], ctx) -> stats
+    freeze_split: int = 0,
+) -> Callable:
+    """Build fn(stacked, rest, heads, tokens, attn_mask, batch) ->
+    (loss, stats, (d_stacked, d_rest, d_heads)).
+
+    - `stacked`: [n_stages, lps, ...] block pytree sharded over "pipe"
+      (the permanent pipelined-trainer layout; interleaved layouts are not
+      supported — the virtual-stage ring would need a second schedule).
+    - `rest`: non-block LM params (embeddings/ln_f/lm_head), replicated
+      over the manual axes (fsdp/tensor shard them under GSPMD-auto).
+    - `heads`: pytree of extra head params the loss consumes (e.g.
+      {"v_head": ...}); pass {} when the loss is LM-only.
+    - `tokens`/`attn_mask`: [B, t] int arrays, batch dim sharded over
+      "data". B/data_ways must divide into n_microbatches.
+    - `batch`: pytree of [B, ...] arrays sliced per microbatch and handed
+      to `loss_mb` (old logprobs, advantages, labels, ...).
+
+    `loss_mb` returns this microbatch's CONTRIBUTION to the final scalar
+    loss (normalize by a global count carried in `ctx` — computed once by
+    `ctx_fn`, which may psum over "data") plus a pytree of per-microbatch
+    stat scalars; `finalize_fn` reduces the [n_ticks] bank of those into
+    the final stats dict (`default_finalize` = gated global sums).
+
+    The returned loss/stats are replicated; d_stacked keeps the stacked
+    sharding; d_rest/d_heads are psummed over ("data", "pipe") — embed
+    grads arrive from stage 0, unembed/head grads from stage S-1, and
+    tied embeddings correctly receive both contributions.
+    """
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = mesh_shape[PIPE_AXIS]
+    data_ways = mesh_shape.get("data", 1)
+    if mesh_shape.get("sequence", 1) != 1:
+        raise NotImplementedError(
+            "the 1F1B schedule does not compose with sequence parallelism "
+            "yet; use pipeline_schedule='gpipe' for PP x SP"
+        )
+    M = int(n_microbatches)
+    RS = min(2 * S - 1, M)  # ring-stash slots; in-flight span at stage i is
+    # 2(S-i)-1, and valid (f, b) pairs obey f - b = 2S-2-2i < RS, so slot
+    # f % RS never collides with a live b % RS (+1 trash slot for bubbles)
+    n_ticks = M + 2 * S - 2
+
+    def embed_apply(rest, tok, pos):
+        return model.apply({"params": rest}, tok, pos, method=model.embed)
+
+    def inner(stacked, rest, heads, tokens, attn_mask, positions, batch):
+        idx = jax.lax.axis_index(PIPE_AXIS)
+        my_layers = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        lps = jax.tree_util.tree_leaves(my_layers)[0].shape[0]
+        # CRITICAL: the vjps below must see device-VARYING params. Inside a
+        # manual shard_map, jax.vjp w.r.t. an invariant (replicated) input
+        # auto-inserts a psum over the manual axes so the cotangent can be
+        # typed invariant — which would hand every device the SUM of all
+        # stages' cotangents (including bubble-tick garbage the per-tick
+        # gating could then never remove) and double-count the data axis
+        # against the explicit psums at the end. pcast-to-varying keeps
+        # each device's cotangent a LOCAL partial; the gated accumulation
+        # + one final psum then reduces exactly once.
+        my_layers = jax.tree_util.tree_map(_vary, my_layers)
+        rest_v = jax.tree_util.tree_map(_vary, rest)
+        heads_v = jax.tree_util.tree_map(_vary, heads)
+
+        B, t = tokens.shape
+        assert B % M == 0, f"local batch {B} not divisible into {M} microbatches"
+        mb = B // M
+        ctx = ctx_fn(tokens, attn_mask, batch) if ctx_fn is not None else None
+
+        tok_mbs = tokens.reshape(M, mb, t)
+        mask_mbs = attn_mask.reshape(M, mb, t)
+        pos_mbs = positions.reshape(M, mb, t)
+        batch_mbs = jax.tree_util.tree_map(
+            lambda x: x.reshape(M, x.shape[0] // M, *x.shape[1:]), batch
+        )
+
+        def stage_fwd(layers, x, mask, pos):
+            bias = train_bias(cfg, mask)
+            return _apply_layer_stack(
+                cfg, layers, x, bias, pos, mask,
+                layer_offset=idx * lps, freeze_split=freeze_split,
+            )
+
+        def loss_head(rest_, heads_, h_, tok, mask, mb_batch):
+            return loss_mb(rest_, heads_, h_, tok, mask, mb_batch, ctx)
+
+        # shapes/dtypes of the activation flowing down (embed output) and
+        # its cotangent flowing up — dtype from an abstract eval so the
+        # carry matches whatever compute dtype the model emits
+        h_shape = jax.eval_shape(
+            embed_apply, rest, tok_mbs[0], pos_mbs[0]
+        )
+        act = lambda: jnp.zeros(h_shape.shape, h_shape.dtype)
+
+        fwd_perm = [(s, s + 1) for s in range(S - 1)]
+        bwd_perm = [(s, s - 1) for s in range(1, S)]
+
+        zero_grads = jax.tree_util.tree_map(
+            jnp.zeros_like, (my_layers, rest, heads)
+        )
+
+        def tick(carry, r):
+            recv_h, recv_dx, stash, d_layers, d_rest, d_heads, loss_acc = carry
+
+            # ---------------- forward slot: microbatch f ----------------
+            f = r - idx
+            valid_f = (f >= 0) & (f < M)
+            fi = jnp.clip(f, 0, M - 1)
+            tok_f = jax.lax.dynamic_index_in_dim(tok_mbs, fi, 0, keepdims=False)
+            mask_f = jax.lax.dynamic_index_in_dim(mask_mbs, fi, 0, keepdims=False)
+            pos_f = jax.lax.dynamic_index_in_dim(pos_mbs, fi, 0, keepdims=False)
+            x0 = embed_apply(rest, tok_f, pos_f)
+            x_in = jnp.where(idx == 0, x0, recv_h)
+            y = stage_fwd(my_layers, x_in, mask_f, pos_f)
+            # stash this stage's INPUT (slot RS is the bubble trash can)
+            slot = jnp.where(valid_f, jnp.mod(f, RS), RS)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, x_in, slot, 0
+            )
+
+            # ---------- loss + backward slot: microbatch b ----------
+            b = r - (2 * S - 2) + idx
+            valid_b = (b >= 0) & (b < M)
+            bi = jnp.clip(b, 0, M - 1)
+            tok_b = jax.lax.dynamic_index_in_dim(tok_mbs, bi, 0, keepdims=False)
+            mask_b = jax.lax.dynamic_index_in_dim(mask_mbs, bi, 0, keepdims=False)
+            pos_b = jax.lax.dynamic_index_in_dim(pos_mbs, bi, 0, keepdims=False)
+            mb_batch_b = jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, bi, 0, keepdims=False),
+                batch_mbs,
+            )
+
+            # On the last stage b == f, so `y` IS microbatch b's final
+            # hidden state; elsewhere the result is predicated away.
+            l, lh_vjp, tick_stats = jax.vjp(
+                functools.partial(
+                    loss_head, tok=tok_b, mask=mask_b, mb_batch=mb_batch_b
+                ),
+                rest_v, heads_v, y, has_aux=True,
+            )
+            dl_rest, dl_heads, dy_last = lh_vjp(_vary(jnp.ones((), l.dtype)))
+
+            x_b = jax.lax.dynamic_index_in_dim(
+                stash, jnp.mod(bi, RS), 0, keepdims=False
+            )
+            dy = jnp.where(idx == S - 1, dy_last.astype(y.dtype), recv_dx)
+            _, s_vjp = jax.vjp(
+                lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b), my_layers, x_b
+            )
+            d_lp, dx = s_vjp(dy)
+
+            # embed backward on stage 0: dx is the cotangent of this
+            # stage's input == the embed output
+            _, e_vjp = jax.vjp(lambda r_: embed_apply(r_, tok_b, pos_b), rest_v)
+            (de_rest,) = e_vjp(dx)
+
+            # jnp.where (not gate-multiply): bubble slots may hold inf/nan
+            last = idx == S - 1
+            first = idx == 0
+            d_layers = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(valid_b, g, 0.0), d_layers, d_lp
+            )
+            d_rest = jax.tree_util.tree_map(
+                lambda acc, gl, ge: acc
+                + jnp.where(valid_b & last, gl, 0.0)
+                + jnp.where(valid_b & first, ge, 0.0),
+                d_rest, dl_rest, de_rest,
+            )
+            d_heads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(valid_b & last, g, 0.0),
+                d_heads, dl_heads,
+            )
+            loss_acc = loss_acc + jnp.where(valid_b & last, l, 0.0)
+
+            next_h = jax.lax.ppermute(y, PIPE_AXIS, fwd_perm)
+            next_dx = jax.lax.ppermute(dx.astype(y.dtype), PIPE_AXIS, bwd_perm)
+            gate = valid_b & last
+            return (
+                (next_h, next_dx, stash, d_layers, d_rest, d_heads, loss_acc),
+                (tick_stats, gate),
+            )
+
+        init = jax.tree_util.tree_map(
+            _vary,
+            (
+                act(), act(),
+                jnp.zeros((RS + 1,) + h_shape.shape, h_shape.dtype),
+                *zero_grads,
+                jnp.zeros((), jnp.float32),
+            ),
+        )
+        carry, (tick_stats, gate) = jax.lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
+        _, _, _, d_layers, d_rest, d_heads, loss_acc = carry
+
+        loss = jax.lax.psum(loss_acc, GRAD_AXES)
+        stats = finalize_fn(tick_stats, gate, ctx)
+        # stage grads stay per-stage (pipe-sharded); data-replicated params
+        # need the data-axis reduction autodiff's transpose would insert
+        d_stacked = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, "data")[None], d_layers
+        )
+        d_rest = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, GRAD_AXES), d_rest
+        )
+        d_heads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, GRAD_AXES), d_heads
+        )
+        return loss, stats, d_stacked, d_rest, d_heads
+
+    b_spec = P("data")
+    smap = partial_shard_map(
+        inner,
+        mesh,
+        in_specs=(P(PIPE_AXIS), P(), P(), b_spec, b_spec, b_spec, b_spec),
+        out_specs=(P(), P(), P(PIPE_AXIS), P(), P()),
+        compute_dtype=cfg.dtype,
+    )
+
+    def fn(stacked, rest, heads, tokens, attn_mask, batch):
+        loss, stats, d_stacked, d_rest, d_heads = smap(
+            stacked, rest, heads, tokens, attn_mask,
+            position_ids(attn_mask), batch,
+        )
+        return loss, stats, (d_stacked, d_rest, d_heads)
+
+    return fn

@@ -366,8 +366,23 @@ class TPUTrainer(BaseRLTrainer):
     # Train step (jit) with gradient accumulation
     # ------------------------------------------------------------------
 
-    def _build_steps(self):
+    def make_grad_fn(self):
+        """(train_params, frozen_params, batch) -> (loss, stats, grads).
+        Default: autodiff of make_loss_fn. Trainers with a hand-written
+        backward (the 1F1B pipeline schedule) override this instead of
+        make_loss_fn."""
         loss_fn = self.make_loss_fn()
+
+        def grad_fn(train_params, frozen_params, batch):
+            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                train_params, frozen_params, batch
+            )
+            return loss, stats, grads
+
+        return grad_fn
+
+    def _build_steps(self):
+        grad_fn = self.make_grad_fn()
         optimizer = self.optimizer
         update_mask = self.make_update_mask()
 
@@ -392,12 +407,6 @@ class TPUTrainer(BaseRLTrainer):
                 jax.lax.with_sharding_constraint(train_params, train_sh),
                 jax.lax.with_sharding_constraint(opt_state, opt_sh),
             )
-
-        def grad_fn(train_params, frozen_params, batch):
-            (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                train_params, frozen_params, batch
-            )
-            return loss, stats, grads
 
         def train_step(train_params, frozen_params, opt_state, batch):
             _, stats, grads = grad_fn(train_params, frozen_params, batch)

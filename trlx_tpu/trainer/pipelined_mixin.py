@@ -32,6 +32,43 @@ from trlx_tpu.utils import logging
 logger = logging.get_logger(__name__)
 
 
+def causal_ce_1f1b_parts(model) -> Dict:
+    """1F1B loss parts for the CE trainers (SFT/RFT): the per-microbatch
+    decomposition of causal_lm_ce_loss — shift-CE summed over valid label
+    positions, normalized by the GLOBAL valid count carried in ctx, so the
+    summed microbatch contributions equal the batch-level loss exactly
+    (up to float reassociation)."""
+    from trlx_tpu.trainer.sft_trainer import ce_shift_labels_and_valid as _labels
+    from trlx_tpu.utils.modeling import logprobs_of_labels
+
+    def prepare(batch):
+        loss_batch = (
+            {"labels": batch["labels"]} if "labels" in batch else {}
+        )
+        return batch["input_ids"], batch["attention_mask"], loss_batch
+
+    def ctx_fn(tokens, attn_mask, batch):
+        _, valid = _labels(tokens, attn_mask, batch.get("labels"))
+        n = jax.lax.psum(valid.sum(), "data")
+        return {"n": jnp.maximum(n, 1).astype(jnp.float32)}
+
+    def loss_mb(rest, heads, h, tok, mask, mb_batch, ctx):
+        del heads
+        logits, _ = model.apply({"params": rest}, h, method=model.unembed)
+        shift_labels, valid = _labels(tok, mask, mb_batch.get("labels"))
+        safe_labels = jnp.where(valid, shift_labels, 0)
+        nll = -logprobs_of_labels(logits[:, :-1, :], safe_labels)
+        contrib = jnp.where(valid, nll, 0.0).sum() / ctx["n"]
+        return contrib, {}
+
+    return {
+        "prepare": prepare,
+        "ctx_fn": ctx_fn,
+        "loss_mb": loss_mb,
+        "wrap_stats": lambda loss, stats: {"loss": loss},
+    }
+
+
 class PipelinedCausalMixin:
     # CE-based trainers (SFT/RFT) read the logit at the position BEFORE
     # each label; under left padding that includes the final pad position
@@ -225,6 +262,18 @@ class PipelinedCausalMixin:
                 )
         return mask or None
 
+    def _freeze_split(self) -> int:
+        """Global layer index below which the pipeline stop_gradients —
+        the ONE definition shared by the GPipe forward and the 1F1B
+        engine so the two schedules can never freeze differently. LoRA's
+        split-0 is a hydra concern (ref branch point), not a freeze
+        boundary: adapters train in every layer."""
+        if getattr(self.model_cfg, "lora_rank", 0) > 0:
+            return 0
+        if self.config.model.num_layers_unfrozen in (-1, 0):
+            return 0
+        return self.split
+
     def make_stacked_lm_forward(self, with_hidden: bool = False):
         """fn(stacked, rest, tokens, mask) through the GPipe program, on a
         fresh TransformerLM module (definitions are pure). Under PP x SP
@@ -235,16 +284,10 @@ class PipelinedCausalMixin:
         keys, so valid positions are unchanged)."""
         from trlx_tpu.models.transformer import TransformerLM
 
-        # LoRA's split-0 is a hydra concern (ref branch point), not a
-        # freeze boundary: adapters train in every layer, so the pipeline
-        # must not stop_gradient anything.
-        freeze_split = 0 if getattr(self.model_cfg, "lora_rank", 0) > 0 else (
-            self.split if self.config.model.num_layers_unfrozen not in (-1, 0) else 0
-        )
         fwd = make_gpipe_forward_stacked(
             TransformerLM(self.model_cfg), self.model_cfg, self.runtime.mesh,
             n_microbatches=self._n_microbatches, with_hidden=with_hidden,
-            n_virtual=self._n_virtual, freeze_split=freeze_split,
+            n_virtual=self._n_virtual, freeze_split=self._freeze_split(),
         )
         mesh = self.runtime.mesh
         seq_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sequence", 1)
@@ -264,6 +307,74 @@ class PipelinedCausalMixin:
             return out[:, :t]
 
         return fwd_padded
+
+    # ------------------------------------------------------------------
+    # 1F1B schedule (parallel.pipeline_schedule: "1f1b")
+    # ------------------------------------------------------------------
+
+    def make_1f1b_loss_parts(self, model) -> Dict:
+        """Per-method pieces the 1F1B engine needs: a dict with
+        "prepare"(batch) -> (tokens, attn_mask, loss_batch), "loss_mb",
+        optional "ctx_fn"/"finalize_fn" (see parallel/onef1b.py), and
+        optional "wrap_stats"(loss, stats) -> stats. Method trainers
+        override; the default refuses so an unsupported method fails
+        loudly instead of silently training with the wrong loss."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement the 1F1B schedule; "
+            "set parallel.pipeline_schedule: 'gpipe'"
+        )
+
+    def make_grad_fn(self):
+        schedule = getattr(self.config.parallel, "pipeline_schedule", "gpipe")
+        if schedule == "gpipe":
+            return super().make_grad_fn()
+        if schedule != "1f1b":
+            raise ValueError(
+                f"parallel.pipeline_schedule must be 'gpipe' or '1f1b', "
+                f"got {schedule!r}"
+            )
+        if self._n_virtual != 1:
+            raise NotImplementedError(
+                "pipeline_schedule='1f1b' does not compose with "
+                "pipeline_interleave > 1 (the virtual-stage ring would need "
+                "a second schedule); use 'gpipe' for interleaved PP"
+            )
+        from flax import traverse_util
+
+        from trlx_tpu.models.transformer import TransformerLM
+        from trlx_tpu.parallel.onef1b import default_finalize, make_1f1b_grad_fn
+
+        model = TransformerLM(self.model_cfg)
+        parts = self.make_1f1b_loss_parts(model)
+        engine = make_1f1b_grad_fn(
+            model, self.model_cfg, self.runtime.mesh, self._n_microbatches,
+            parts["loss_mb"], ctx_fn=parts.get("ctx_fn"),
+            finalize_fn=parts.get("finalize_fn", default_finalize),
+            freeze_split=self._freeze_split(),
+        )
+        prepare = parts["prepare"]
+        wrap_stats = parts.get("wrap_stats", lambda loss, stats: stats)
+
+        def grad_fn(train_params, frozen_params, batch):
+            params = merge_params(train_params, frozen_params)
+            heads = {
+                k: v for k, v in params.items()
+                if k not in ("lm_stacked", "lm_rest")
+            }
+            tokens, attn_mask, loss_batch = prepare(batch)
+            loss, stats, (d_stacked, d_rest, d_heads) = engine(
+                params["lm_stacked"], params["lm_rest"], heads,
+                tokens, attn_mask, loss_batch,
+            )
+            flat = traverse_util.flatten_dict(
+                {"lm_stacked": d_stacked, "lm_rest": d_rest, **d_heads}
+            )
+            # frozen leaves' grads are computed by the stage vjp anyway
+            # (dw rides the same transposed matmuls) and dropped here
+            grads = {k: flat[k] for k in train_params}
+            return loss, wrap_stats(loss, stats), grads
+
+        return grad_fn
 
     def standard_params(self) -> Dict:
         """Unstacked view in the regular model layout (for generation,

@@ -132,6 +132,151 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
         return loss_fn
 
     # ------------------------------------------------------------------
+    # 1F1B loss (parallel.pipeline_schedule: "1f1b"): the per-microbatch
+    # decomposition of ppo_loss. Every sum in the clipped objective and
+    # its stats is normalized by the GLOBAL masked-token count (computed
+    # once in ctx), so summed microbatch contributions equal the
+    # batch-level loss exactly; min/max stats ride pmin/pmax and std uses
+    # the algebraically-equal sqrt(E[x^2] - mean^2) form.
+    # ------------------------------------------------------------------
+
+    def make_1f1b_loss_parts(self, model):
+        method = self.config.method
+        pad_id = self.tokenizer.pad_token_id
+        v_head = self._head_module()
+        mesh = self.runtime.mesh
+        data_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+
+        from trlx_tpu.parallel.onef1b import GRAD_AXES
+
+        def prepare(batch: PPORLBatch):
+            tokens = jnp.concatenate(
+                [batch.query_tensors, batch.response_tensors], axis=1
+            )
+            attn = (tokens != pad_id).astype(jnp.int32)
+            advantages, returns = get_advantages_and_returns(
+                batch.values, batch.rewards, method.gamma, method.lam
+            )
+            loss_batch = dict(
+                query=batch.query_tensors,
+                old_logprobs=batch.logprobs,
+                old_values=batch.values,
+                advantages=advantages,
+                returns=returns,
+            )
+            return tokens, attn, loss_batch
+
+        def ctx_fn(tokens, attn_mask, batch):
+            start = batch["query"].shape[1] - 1
+            L = batch["old_logprobs"].shape[1]
+            m = attn_mask[:, start + 1 : start + L + 1]
+            n = jnp.maximum(
+                jax.lax.psum(m.sum(), "data").astype(jnp.float32), 1.0
+            )
+            return {"n": n, "size": float(tokens.shape[0] * data_ways * L)}
+
+        def _sums(x, m):
+            return dict(
+                s=(x * m).sum(),
+                s2=(x * x * m).sum(),
+                min=jnp.where(m > 0, x, jnp.inf).min(),
+                max=jnp.where(m > 0, x, -jnp.inf).max(),
+            )
+
+        def loss_mb(rest, heads, h, tok, mask, mb, ctx):
+            logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
+            values = v_head.apply({"params": heads["v_head"]}, h_final)[..., 0]
+            lp_all = logprobs_of_labels(logits[:, :-1, :], tok[:, 1:])
+            start = mb["query"].shape[1] - 1
+            L = mb["old_logprobs"].shape[1]
+            lp = lp_all[:, start : start + L]
+            vp = values[:, :-1][:, start : start + L]
+            m = mask[:, start + 1 : start + L + 1].astype(jnp.float32)
+            old_lp, old_v = mb["old_logprobs"], mb["old_values"]
+            adv, ret = mb["advantages"], mb["returns"]
+            n = ctx["n"]
+
+            vc = jnp.clip(
+                vp, old_v - method.cliprange_value, old_v + method.cliprange_value
+            )
+            vf1 = (vp - ret) ** 2
+            vf2 = (vc - ret) ** 2
+            vf_max_sum = (jnp.maximum(vf1, vf2) * m).sum()
+            log_ratio = (lp - old_lp) * m
+            ratio = jnp.exp(log_ratio)
+            pg1 = -adv * ratio
+            pg2 = -adv * jnp.clip(
+                ratio, 1.0 - method.cliprange, 1.0 + method.cliprange
+            )
+            pg_sum = (jnp.maximum(pg1, pg2) * m).sum()
+
+            loss_contrib = pg_sum / n + method.vf_coef * 0.5 * vf_max_sum / n
+            stats = dict(
+                pg_sum=pg_sum,
+                vf_max_sum=vf_max_sum,
+                vf_clip_sum=((vf2 > vf1).astype(jnp.float32) * m).sum(),
+                pg_clip_sum=((pg2 > pg1).astype(jnp.float32) * m).sum(),
+                ratio_sum=(ratio * m).sum(),
+                kl_sum=((ratio - 1) - log_ratio).sum(),
+                verr_sum=(((vp - ret) * m) ** 2).sum(),
+                values=_sums(vp, m),
+                old_values=_sums(old_v, m),
+                returns=_sums(ret, m),
+            )
+            return loss_contrib, jax.lax.stop_gradient(stats)
+
+        def finalize_fn(ts, gate, ctx):
+            n, size = ctx["n"], ctx["size"]
+
+            def gsum(leaf):
+                return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
+
+            def gmin(leaf):
+                return jax.lax.pmin(jnp.where(gate, leaf, jnp.inf).min(), GRAD_AXES)
+
+            def gmax(leaf):
+                return jax.lax.pmax(jnp.where(gate, leaf, -jnp.inf).max(), GRAD_AXES)
+
+            def tensor_stats(d):
+                mean = gsum(d["s"]) / n
+                e2 = gsum(d["s2"]) / n
+                return dict(
+                    mean=mean,
+                    min=gmin(d["min"]),
+                    max=gmax(d["max"]),
+                    std=jnp.sqrt(jnp.maximum(e2 - mean * mean, 0.0)),
+                )
+
+            pg_loss = gsum(ts["pg_sum"]) / n
+            vf_loss = 0.5 * gsum(ts["vf_max_sum"]) / n
+            loss = pg_loss + method.vf_coef * vf_loss
+            return dict(
+                losses=dict(
+                    total_loss=loss, policy_loss=pg_loss, value_loss=vf_loss
+                ),
+                values=dict(
+                    **tensor_stats(ts["values"]),
+                    values_error=gsum(ts["verr_sum"]) / n,
+                    clipfrac=gsum(ts["vf_clip_sum"]) / n,
+                ),
+                old_values=tensor_stats(ts["old_values"]),
+                returns=tensor_stats(ts["returns"]),
+                policy=dict(
+                    approx_kl=gsum(ts["kl_sum"]) / size,
+                    clipfrac=gsum(ts["pg_clip_sum"]) / n,
+                ),
+                ratio=gsum(ts["ratio_sum"]) / n,
+                padding_percentage=1.0 - n / size,
+            )
+
+        return {
+            "prepare": prepare,
+            "ctx_fn": ctx_fn,
+            "loss_mb": loss_mb,
+            "finalize_fn": finalize_fn,
+        }
+
+    # ------------------------------------------------------------------
     # Rollout scorer: double pipelined pass (policy+value, then reference)
     # ------------------------------------------------------------------
 

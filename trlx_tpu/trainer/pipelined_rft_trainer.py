@@ -31,6 +31,13 @@ class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
             mask["v_head"] = jax.tree_util.tree_map(lambda _: False, mask["v_head"])
         return mask
 
+    def make_1f1b_loss_parts(self, model):
+        # RFT batches carry no labels key, so the shared CE parts fall back
+        # to labels=input_ids-over-real-tokens — exactly RFT's loss
+        from trlx_tpu.trainer.pipelined_mixin import causal_ce_1f1b_parts
+
+        return causal_ce_1f1b_parts(model)
+
     def make_loss_fn(self) -> Callable:
         fwd = self.make_stacked_lm_forward()
 

@@ -54,6 +54,11 @@ class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
             mask["v_head"] = jax.tree_util.tree_map(lambda _: False, mask["v_head"])
         return mask
 
+    def make_1f1b_loss_parts(self, model):
+        from trlx_tpu.trainer.pipelined_mixin import causal_ce_1f1b_parts
+
+        return causal_ce_1f1b_parts(model)
+
     def make_loss_fn(self) -> Callable:
         fwd = self.make_stacked_lm_forward()
 

@@ -29,16 +29,28 @@ class SFTConfig(MethodConfig):
     gen_kwargs: dict = field(default_factory=dict)
 
 
+def ce_shift_labels_and_valid(input_ids, attention_mask, labels=None):
+    """The one definition of SFT/RFT CE targets: labels default to
+    input_ids over real tokens (reference accelerate_sft_trainer.py:63-70
+    masks labels by attention; RFT uses labels=input_ids), shifted one
+    right, valid where not IGNORE_INDEX and attended. Shared by the plain,
+    pipelined-GPipe and 1F1B loss paths so their masking cannot drift."""
+    ignore_index = DialogStore.IGNORE_INDEX
+    if labels is None:
+        labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
+    shift_labels = labels[:, 1:]
+    valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
+    return shift_labels, valid
+
+
 def causal_lm_ce_loss(logits, input_ids, attention_mask, labels=None):
     """Shifted CE over real tokens (reference
     accelerate_sft_trainer.py:63-70 masks labels by attention). Shared by
     the plain and pipelined SFT trainers so their losses cannot drift."""
-    ignore_index = DialogStore.IGNORE_INDEX
-    if labels is None:
-        labels = jnp.where(attention_mask > 0, input_ids, ignore_index)
+    shift_labels, valid = ce_shift_labels_and_valid(
+        input_ids, attention_mask, labels
+    )
     shift_logits = logits[:, :-1, :]
-    shift_labels = labels[:, 1:]
-    valid = (shift_labels != ignore_index) & (attention_mask[:, 1:] > 0)
     safe_labels = jnp.where(valid, shift_labels, 0)
     nll = -logprobs_of_labels(shift_logits, safe_labels)
     n = jnp.maximum(valid.sum(), 1)

@@ -1,0 +1,44 @@
+"""The two walkthrough notebooks (counterparts of the reference's
+examples/notebooks/trlx_sentiments.ipynb and trlx_simulacra.ipynb)
+actually execute: every code cell runs in order in one namespace with
+TRLX_TPU_NB_SMOKE shrinking steps/batches — the reference never tests its
+notebooks at all (SURVEY.md §4)."""
+
+import json
+import os
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+
+
+def _run_notebook(path):
+    nb = json.load(open(path))
+    assert nb["nbformat"] == 4
+    cells = [c for c in nb["cells"] if c["cell_type"] == "code"]
+    assert len(cells) >= 4
+    os.environ["TRLX_TPU_NB_SMOKE"] = "1"
+    cwd = os.getcwd()
+    ns = {}
+    try:
+        os.chdir(REPO)
+        for i, cell in enumerate(cells):
+            src = "".join(cell["source"])
+            try:
+                exec(compile(src, f"{os.path.basename(path)}:cell{i}", "exec"), ns)
+            except Exception as e:
+                raise AssertionError(
+                    f"cell {i} of {path} failed: {e}\n--- cell source ---\n{src}"
+                ) from e
+    finally:
+        os.chdir(cwd)
+        os.environ.pop("TRLX_TPU_NB_SMOKE", None)
+    return ns
+
+
+@pytest.mark.parametrize(
+    "name", ["trlx_tpu_sentiments.ipynb", "trlx_tpu_simulacra.ipynb"]
+)
+def test_notebook_executes(name):
+    ns = _run_notebook(os.path.join(REPO, "examples", "notebooks", name))
+    assert ns["trainer"].iter_count >= 2

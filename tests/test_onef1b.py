@@ -280,12 +280,14 @@ def test_pipelined_ppo_trainer_1f1b(tmp_path):
     _flat_close(g1, g0, rtol=2e-4, atol=1e-5)
 
 
-def test_ilql_refuses_1f1b():
-    """Methods without a 1F1B loss decomposition must fail loudly."""
-    import jax as _jax
-
+def test_pipelined_ilql_trainer_1f1b(tmp_path):
+    """PipelinedILQLTrainer under the 1F1B schedule: offline RL
+    end-to-end (incl. Polyak target sync on the stacked layout), plus
+    grad AND stats parity of the decomposed ilql_loss — Q-target fit,
+    expectile V, CQL, AWAC and the per-head tensor stats all match the
+    batch-level computation."""
+    import trlx_tpu as trlx
     from trlx_tpu.data.default_configs import default_ilql_config
-    from trlx_tpu.trainer.pipelined_ilql_trainer import PipelinedILQLTrainer
 
     config = default_ilql_config().evolve(
         model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
@@ -293,12 +295,59 @@ def test_ilql_refuses_1f1b():
         tokenizer=dict(tokenizer_path="byte"),
         train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
                    eval_interval=10, checkpoint_interval=100,
-                   trainer="PipelinedILQLTrainer", seed=5),
+                   trainer="PipelinedILQLTrainer",
+                   checkpoint_dir=str(tmp_path / "ilql1f1b"), seed=5),
+        method=dict(steps_for_target_q_sync=1, alpha=1.0,
+                    gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0,
+                                    temperature=1.0)),
         parallel=dict(data=4, fsdp=1, tensor=1, pipeline=2,
                       pipeline_schedule="1f1b"),
     )
-    trainer = PipelinedILQLTrainer(config)
-    with pytest.raises(NotImplementedError, match="1F1B"):
+    samples = [("ask", " yes"), ("ask", " no"), ("q", " maybe"), ("q", " sure")] * 4
+    rewards = [1.0, -1.0, 0.5, 0.2] * 4
+    trainer = trlx.train(
+        samples=samples, rewards=rewards, eval_prompts=["ask", "q"],
+        config=config,
+    )
+    assert trainer.iter_count >= 2
+
+    batch = trainer.batch_to_device(
+        next(iter(trainer.store.create_loader(8, shuffle=False, drop_last=True)))
+    )
+    grad_fn = jax.jit(trainer.make_grad_fn())
+    loss_fn = trainer.make_loss_fn()
+
+    def ref(train_params, frozen_params, batch):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_params, frozen_params, batch
+        )
+        return loss, stats, grads
+
+    l1, s1, g1 = grad_fn(trainer.train_params, trainer.frozen_params, batch)
+    l0, s0, g0 = jax.jit(ref)(trainer.train_params, trainer.frozen_params, batch)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
+    _flat_close(s1, s0, rtol=2e-4, atol=1e-5)
+    _flat_close(g1, g0, rtol=2e-4, atol=1e-5)
+
+
+def test_interleave_refuses_1f1b():
+    """The 1F1B schedule has no virtual-stage variant yet — combining it
+    with pipeline_interleave must fail loudly, not train wrong."""
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.pipelined_sft_trainer import PipelinedSFTTrainer
+
+    config = default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32", n_layers=4)),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=10, checkpoint_interval=100,
+                   trainer="PipelinedSFTTrainer", seed=5),
+        parallel=dict(data=4, fsdp=1, tensor=1, pipeline=2,
+                      pipeline_interleave=2, pipeline_schedule="1f1b"),
+    )
+    trainer = PipelinedSFTTrainer(config)
+    with pytest.raises(NotImplementedError, match="interleave"):
         trainer.make_grad_fn()
 
 

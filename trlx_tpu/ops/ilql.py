@@ -26,7 +26,7 @@ def batched_index_select(x: jnp.ndarray, idxs: jnp.ndarray, axis: int = 1) -> jn
     return jnp.take_along_axis(x, idxs[..., None], axis=axis)
 
 
-def ilql_loss(
+def ilql_loss_terms(
     logits: jnp.ndarray,  # [b, t, V] over full sequence
     qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
     target_qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
@@ -37,15 +37,15 @@ def ilql_loss(
     rewards: jnp.ndarray,  # [b, n_actions]
     tau: float,
     gamma: float,
-    cql_scale: float,
-    awac_scale: float,
     beta: float = 0.0,
-) -> Tuple[jnp.ndarray, Dict]:
-    """Reference math (modeling_ilql.py:95-166): actions are the tokens at
-    positions actions_ixs of the shifted sequence; Q/V heads were already
-    index-selected by the model."""
+) -> Tuple[Dict, Dict]:
+    """SUM-form terms of the ILQL objective over this (micro)batch —
+    everything in ilql_loss except the divide by the nonterminal count, so
+    the batch-level loss and the 1F1B per-microbatch decomposition share
+    ONE definition of the math (reference modeling_ilql.py:95-166).
+    Returns (terms, aux) where terms are scalar sums and aux carries the
+    per-position tensors (V, Q, terminal_mask) the stats need."""
     terminal_mask = dones[:, :-1].astype(jnp.float32)  # [b, n_actions]
-    n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
 
     # token ids actually taken at each action position
     actions = jnp.take_along_axis(input_ids[:, 1:], actions_ixs, axis=1)  # [b, n_actions]
@@ -64,31 +64,62 @@ def ilql_loss(
     Vnext = vs[:, 1:, 0] * dones[:, 1:].astype(vs.dtype)  # 0 past the end
     Q_target = rewards + gamma * jax.lax.stop_gradient(Vnext)
 
-    loss_q = sum(
-        (((Qi - Q_target) ** 2) * terminal_mask).sum() / n_nonterminal for Qi in Q
-    )
+    q_sum = sum((((Qi - Q_target) ** 2) * terminal_mask).sum() for Qi in Q)
 
     # expectile regression of V toward min-target-Q
     diff = targetQ - V
-    loss_v = (
-        (jnp.where(diff >= 0, tau, 1 - tau) * diff**2) * terminal_mask
-    ).sum() / n_nonterminal
+    v_sum = ((jnp.where(diff >= 0, tau, 1 - tau) * diff**2) * terminal_mask).sum()
 
-    def cql_loss_fn(q):
+    def cql_sum_fn(q):
         # cross-entropy of the Q "logits" against the taken actions
         logprobs = jax.nn.log_softmax(q.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logprobs, actions[..., None], axis=-1)[..., 0]
-        return (nll * terminal_mask).sum() / n_nonterminal
+        return (nll * terminal_mask).sum()
 
-    loss_cql = sum(cql_loss_fn(q) for q in qs)
+    cql_sum = sum(cql_sum_fn(q) for q in qs)
 
     # AWAC: CE of the LM logits at action positions, weighted by exp(beta * A)
     action_logits = batched_index_select(logits, actions_ixs, axis=1)
     lp = jax.nn.log_softmax(action_logits.astype(jnp.float32), axis=-1)
     cross_entropy = -jnp.take_along_axis(lp, actions[..., None], axis=-1)[..., 0]
     awac_weight = jax.lax.stop_gradient(jnp.exp(beta * (targetQ - V)))
-    loss_awac = (cross_entropy * awac_weight * terminal_mask).sum() / n_nonterminal
+    awac_sum = (cross_entropy * awac_weight * terminal_mask).sum()
 
+    terms = dict(q_sum=q_sum, v_sum=v_sum, cql_sum=cql_sum, awac_sum=awac_sum)
+    aux = dict(V=V, Q=Q, terminal_mask=terminal_mask)
+    return terms, aux
+
+
+def ilql_loss(
+    logits: jnp.ndarray,  # [b, t, V] over full sequence
+    qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
+    target_qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]
+    vs: jnp.ndarray,  # [b, n_states, 1] (n_states = n_actions + 1)
+    input_ids: jnp.ndarray,  # [b, t]
+    actions_ixs: jnp.ndarray,  # [b, n_actions]
+    dones: jnp.ndarray,  # [b, n_states]
+    rewards: jnp.ndarray,  # [b, n_actions]
+    tau: float,
+    gamma: float,
+    cql_scale: float,
+    awac_scale: float,
+    beta: float = 0.0,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Reference math (modeling_ilql.py:95-166): actions are the tokens at
+    positions actions_ixs of the shifted sequence; Q/V heads were already
+    index-selected by the model."""
+    terms, aux = ilql_loss_terms(
+        logits, qs, target_qs, vs, input_ids, actions_ixs, dones, rewards,
+        tau=tau, gamma=gamma, beta=beta,
+    )
+    terminal_mask = aux["terminal_mask"]
+    V, Q = aux["V"], aux["Q"]
+    n_nonterminal = jnp.maximum(terminal_mask.sum(), 1.0)
+
+    loss_q = terms["q_sum"] / n_nonterminal
+    loss_v = terms["v_sum"] / n_nonterminal
+    loss_cql = terms["cql_sum"] / n_nonterminal
+    loss_awac = terms["awac_sum"] / n_nonterminal
     loss = loss_q + loss_v + cql_scale * loss_cql + awac_scale * loss_awac
 
     stats = dict(

@@ -79,6 +79,47 @@ def _vary(x):
     return pcast(x, missing, to="varying") if missing else x
 
 
+def masked_sums(x, m):
+    """Per-microbatch accumulators from which finalize_tensor_stats can
+    rebuild get_tensor_stats (mean/min/max/std over masked entries)
+    exactly: sums + sum-of-squares + masked min/max."""
+    return dict(
+        s=(x * m).sum(),
+        s2=(x * x * m).sum(),
+        min=jnp.where(m > 0, x, jnp.inf).min(),
+        max=jnp.where(m > 0, x, -jnp.inf).max(),
+    )
+
+
+def gated_reducers(gate):
+    """(gsum, gmin, gmax) over the [n_ticks] stat bank: gated to the
+    real last-stage ticks and reduced over ("data", "pipe")."""
+
+    def gsum(leaf):
+        return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
+
+    def gmin(leaf):
+        return jax.lax.pmin(jnp.where(gate, leaf, jnp.inf).min(), GRAD_AXES)
+
+    def gmax(leaf):
+        return jax.lax.pmax(jnp.where(gate, leaf, -jnp.inf).max(), GRAD_AXES)
+
+    return gsum, gmin, gmax
+
+
+def finalize_tensor_stats(d, n, gsum, gmin, gmax):
+    """get_tensor_stats from banked masked_sums; std uses the
+    algebraically-equal sqrt(E[x^2] - mean^2) form."""
+    mean = gsum(d["s"]) / n
+    e2 = gsum(d["s2"]) / n
+    return dict(
+        mean=mean,
+        min=gmin(d["min"]),
+        max=gmax(d["max"]),
+        std=jnp.sqrt(jnp.maximum(e2 - mean * mean, 0.0)),
+    )
+
+
 def default_finalize(tick_stats, gate, ctx):
     """Sum-decomposed stats: every leaf is a per-microbatch SUM contribution;
     the final stat is the global sum (pipe+data psum of the gated tick sums).

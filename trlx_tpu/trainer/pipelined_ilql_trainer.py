@@ -76,3 +76,96 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
             )
 
         return loss_fn
+
+    # ------------------------------------------------------------------
+    # 1F1B loss (parallel.pipeline_schedule: "1f1b"): per-microbatch
+    # decomposition of ilql_loss. The math lives once in
+    # ops/ilql.py::ilql_loss_terms (sum form); contributions are divided
+    # by the GLOBAL nonterminal count carried in ctx, so summed microbatch
+    # losses equal the batch-level loss exactly.
+    # ------------------------------------------------------------------
+
+    def make_1f1b_loss_parts(self, model):
+        cfg = self.ilql
+        heads_mod = ILQLHeads(
+            self.model_cfg.vocab_size, cfg.two_qs,
+            self.model_cfg.dtype, self.model_cfg.param_dtype,
+        )
+
+        from trlx_tpu.ops.ilql import ilql_loss_terms
+        from trlx_tpu.parallel.onef1b import (
+            finalize_tensor_stats,
+            gated_reducers,
+            masked_sums,
+        )
+
+        def prepare(batch: ILQLBatch):
+            loss_batch = dict(
+                states_ixs=batch.states_ixs,
+                actions_ixs=batch.actions_ixs,
+                dones=batch.dones,
+                rewards=batch.rewards,
+            )
+            return batch.input_ids, batch.attention_mask, loss_batch
+
+        def ctx_fn(tokens, attn_mask, batch):
+            n_local = batch["dones"][:, :-1].astype(jnp.float32).sum()
+            return {"n": jnp.maximum(jax.lax.psum(n_local, "data"), 1.0)}
+
+        def loss_mb(rest, heads, h, tok, mask, mb, ctx):
+            logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
+            qs, target_qs, vs = heads_mod.apply(
+                {"params": heads["ilql_heads"]}, h_final,
+                mb["states_ixs"], mb["actions_ixs"],
+            )
+            terms, aux = ilql_loss_terms(
+                logits, qs, target_qs, vs,
+                tok, mb["actions_ixs"], mb["dones"], mb["rewards"],
+                tau=cfg.tau, gamma=cfg.gamma, beta=cfg.beta,
+            )
+            n = ctx["n"]
+            contrib = (
+                terms["q_sum"] + terms["v_sum"]
+                + cfg.cql_scale * terms["cql_sum"]
+                + cfg.awac_scale * terms["awac_sum"]
+            ) / n
+            tm = aux["terminal_mask"]
+            stats = dict(
+                **terms,
+                values=masked_sums(aux["V"], tm),
+                qvalues={
+                    str(ix): masked_sums(aux["Q"][ix], tm)
+                    for ix in range(len(aux["Q"]))
+                },
+            )
+            return contrib, jax.lax.stop_gradient(stats)
+
+        def finalize_fn(ts, gate, ctx):
+            n = ctx["n"]
+            gsum, gmin, gmax = gated_reducers(gate)
+            loss_q = gsum(ts["q_sum"]) / n
+            loss_v = gsum(ts["v_sum"]) / n
+            loss_cql = gsum(ts["cql_sum"]) / n
+            loss_awac = gsum(ts["awac_sum"]) / n
+            loss = (
+                loss_q + loss_v + cfg.cql_scale * loss_cql
+                + cfg.awac_scale * loss_awac
+            )
+            return dict(
+                losses=dict(
+                    loss=loss, loss_q=loss_q, loss_v=loss_v,
+                    loss_cql=loss_cql, loss_awac=loss_awac,
+                ),
+                values=finalize_tensor_stats(ts["values"], n, gsum, gmin, gmax),
+                qvalues={
+                    k: finalize_tensor_stats(d, n, gsum, gmin, gmax)
+                    for k, d in ts["qvalues"].items()
+                },
+            )
+
+        return {
+            "prepare": prepare,
+            "ctx_fn": ctx_fn,
+            "loss_mb": loss_mb,
+            "finalize_fn": finalize_fn,
+        }

@@ -147,7 +147,11 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
         mesh = self.runtime.mesh
         data_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
 
-        from trlx_tpu.parallel.onef1b import GRAD_AXES
+        from trlx_tpu.parallel.onef1b import (
+            finalize_tensor_stats,
+            gated_reducers,
+            masked_sums,
+        )
 
         def prepare(batch: PPORLBatch):
             tokens = jnp.concatenate(
@@ -174,14 +178,6 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
                 jax.lax.psum(m.sum(), "data").astype(jnp.float32), 1.0
             )
             return {"n": n, "size": float(tokens.shape[0] * data_ways * L)}
-
-        def _sums(x, m):
-            return dict(
-                s=(x * m).sum(),
-                s2=(x * x * m).sum(),
-                min=jnp.where(m > 0, x, jnp.inf).min(),
-                max=jnp.where(m > 0, x, -jnp.inf).max(),
-            )
 
         def loss_mb(rest, heads, h, tok, mask, mb, ctx):
             logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
@@ -219,33 +215,18 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
                 ratio_sum=(ratio * m).sum(),
                 kl_sum=((ratio - 1) - log_ratio).sum(),
                 verr_sum=(((vp - ret) * m) ** 2).sum(),
-                values=_sums(vp, m),
-                old_values=_sums(old_v, m),
-                returns=_sums(ret, m),
+                values=masked_sums(vp, m),
+                old_values=masked_sums(old_v, m),
+                returns=masked_sums(ret, m),
             )
             return loss_contrib, jax.lax.stop_gradient(stats)
 
         def finalize_fn(ts, gate, ctx):
             n, size = ctx["n"], ctx["size"]
-
-            def gsum(leaf):
-                return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
-
-            def gmin(leaf):
-                return jax.lax.pmin(jnp.where(gate, leaf, jnp.inf).min(), GRAD_AXES)
-
-            def gmax(leaf):
-                return jax.lax.pmax(jnp.where(gate, leaf, -jnp.inf).max(), GRAD_AXES)
+            gsum, gmin, gmax = gated_reducers(gate)
 
             def tensor_stats(d):
-                mean = gsum(d["s"]) / n
-                e2 = gsum(d["s2"]) / n
-                return dict(
-                    mean=mean,
-                    min=gmin(d["min"]),
-                    max=gmax(d["max"]),
-                    std=jnp.sqrt(jnp.maximum(e2 - mean * mean, 0.0)),
-                )
+                return finalize_tensor_stats(d, n, gsum, gmin, gmax)
 
             pg_loss = gsum(ts["pg_sum"]) / n
             vf_loss = 0.5 * gsum(ts["vf_max_sum"]) / n

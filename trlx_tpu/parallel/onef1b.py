@@ -57,7 +57,13 @@ from trlx_tpu.parallel.pipeline import (
     partial_shard_map,
 )
 
-GRAD_AXES = ("data", PIPE_AXIS)
+# Reduction axes for cross-device grad/stat sums. "sequence" is present
+# (size 1 unless PP x SP) because activations shard over it: each sequence
+# shard's vjp yields a PARTIAL param cotangent, reduced with the data-axis
+# partials in the same psum. Stage (layer) grads reduce over LAYER_AXES
+# only — they stay sharded over "pipe".
+GRAD_AXES = ("data", "sequence", PIPE_AXIS)
+LAYER_AXES = ("data", "sequence")
 
 
 def _vary(x):
@@ -172,16 +178,21 @@ def make_1f1b_grad_fn(
     mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = mesh_shape[PIPE_AXIS]
     data_ways = mesh_shape.get("data", 1)
-    if mesh_shape.get("sequence", 1) != 1:
-        raise NotImplementedError(
-            "the 1F1B schedule does not compose with sequence parallelism "
-            "yet; use pipeline_schedule='gpipe' for PP x SP"
-        )
     M = int(n_microbatches)
     RS = min(2 * S - 1, M)  # ring-stash slots; in-flight span at stage i is
     # 2(S-i)-1, and valid (f, b) pairs obey f - b = 2S-2-2i < RS, so slot
     # f % RS never collides with a live b % RS (+1 trash slot for bubbles)
     n_ticks = M + 2 * S - 2
+    # With no GSPMD-auto axis active, the loss head (unembed+loss fwd+vjp,
+    # the d x V matmuls) and the embed vjp can run under lax.cond so only
+    # the one stage that keeps the result pays for it — removing the ~S x
+    # loss-head overcompute of pure where-predication. With auto axes
+    # (TP/FSDP inside the pipe program) the branches would contain
+    # GSPMD-inserted collectives under a device-varying predicate, so
+    # there we keep the predicated always-compute form.
+    full_manual = all(
+        mesh_shape.get(ax, 1) == 1 for ax in ("fsdp", "tensor")
+    )
 
     def embed_apply(rest, tok, pos):
         return model.apply({"params": rest}, tok, pos, method=model.embed)
@@ -271,20 +282,45 @@ def make_1f1b_grad_fn(
                 batch_mbs,
             )
 
+            last = idx == S - 1
+            first = idx == 0
+
             # On the last stage b == f, so `y` IS microbatch b's final
-            # hidden state; elsewhere the result is predicated away.
-            l, lh_vjp, tick_stats = jax.vjp(
-                functools.partial(
-                    loss_head, tok=tok_b, mask=mask_b, mb_batch=mb_batch_b
-                ),
-                rest_v, heads_v, y, has_aux=True,
-            )
-            dl_rest, dl_heads, dy_last = lh_vjp(_vary(jnp.ones((), l.dtype)))
+            # hidden state; elsewhere (and on bubble ticks) the result is
+            # skipped via lax.cond on full-manual meshes, or computed and
+            # predicated away where auto axes forbid the cond.
+            def loss_slot(args):
+                y_, tok_, mask_, mbb = args
+                l, lh_vjp, tick_stats = jax.vjp(
+                    functools.partial(
+                        loss_head, tok=tok_, mask=mask_, mb_batch=mbb
+                    ),
+                    rest_v, heads_v, y_, has_aux=True,
+                )
+                dl_rest, dl_heads, dy_last = lh_vjp(
+                    _vary(jnp.ones((), l.dtype))
+                )
+                return l, tick_stats, dl_rest, dl_heads, dy_last.astype(y_.dtype)
+
+            loss_args = (y, tok_b, mask_b, mb_batch_b)
+            if full_manual:
+                out_shapes = jax.eval_shape(loss_slot, loss_args)
+
+                def loss_skip(args):
+                    return jax.tree_util.tree_map(
+                        lambda s: _vary(jnp.zeros(s.shape, s.dtype)), out_shapes
+                    )
+
+                l, tick_stats, dl_rest, dl_heads, dy_last = jax.lax.cond(
+                    last & valid_b, loss_slot, loss_skip, loss_args
+                )
+            else:
+                l, tick_stats, dl_rest, dl_heads, dy_last = loss_slot(loss_args)
 
             x_b = jax.lax.dynamic_index_in_dim(
                 stash, jnp.mod(bi, RS), 0, keepdims=False
             )
-            dy = jnp.where(idx == S - 1, dy_last.astype(y.dtype), recv_dx)
+            dy = jnp.where(idx == S - 1, dy_last, recv_dx)
             _, s_vjp = jax.vjp(
                 lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b), my_layers, x_b
             )
@@ -292,12 +328,29 @@ def make_1f1b_grad_fn(
 
             # embed backward on stage 0: dx is the cotangent of this
             # stage's input == the embed output
-            _, e_vjp = jax.vjp(lambda r_: embed_apply(r_, tok_b, pos_b), rest_v)
-            (de_rest,) = e_vjp(dx)
+            def embed_slot(args):
+                tok_, pos_, dx_ = args
+                _, e_vjp = jax.vjp(
+                    lambda r_: embed_apply(r_, tok_, pos_), rest_v
+                )
+                return e_vjp(dx_)[0]
+
+            embed_args = (tok_b, pos_b, dx)
+            if full_manual:
+                rest_shapes = jax.eval_shape(embed_slot, embed_args)
+
+                def embed_skip(args):
+                    return jax.tree_util.tree_map(
+                        lambda s: _vary(jnp.zeros(s.shape, s.dtype)), rest_shapes
+                    )
+
+                de_rest = jax.lax.cond(
+                    first & valid_b, embed_slot, embed_skip, embed_args
+                )
+            else:
+                de_rest = embed_slot(embed_args)
 
             # jnp.where (not gate-multiply): bubble slots may hold inf/nan
-            last = idx == S - 1
-            first = idx == 0
             d_layers = jax.tree_util.tree_map(
                 lambda acc, g: acc + jnp.where(valid_b, g, 0.0), d_layers, d_lp
             )
@@ -337,10 +390,11 @@ def make_1f1b_grad_fn(
 
         loss = jax.lax.psum(loss_acc, GRAD_AXES)
         stats = finalize_fn(tick_stats, gate, ctx)
-        # stage grads stay per-stage (pipe-sharded); data-replicated params
-        # need the data-axis reduction autodiff's transpose would insert
+        # stage grads stay per-stage (pipe-sharded); data/sequence-
+        # replicated params need the reduction autodiff's transpose
+        # would insert
         d_stacked = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, "data")[None], d_layers
+            lambda g: jax.lax.psum(g, LAYER_AXES)[None], d_layers
         )
         d_rest = jax.tree_util.tree_map(
             lambda g: jax.lax.psum(g, GRAD_AXES), d_rest
@@ -350,7 +404,12 @@ def make_1f1b_grad_fn(
         )
         return loss, stats, d_stacked, d_rest, d_heads
 
-    b_spec = P("data")
+    # batch dim over "data", sequence dim over "sequence" (size 1 except
+    # PP x SP, where the stage runs ring attention and the loss consumes
+    # globally-preshifted per-position targets). Position ids come from
+    # the GLOBAL mask before the shard_map — a shard-local cumsum would
+    # restart at 0 per sequence shard.
+    b_spec = P("data", "sequence")
     smap = partial_shard_map(
         inner,
         mesh,

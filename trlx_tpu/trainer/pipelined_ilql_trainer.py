@@ -110,7 +110,13 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
 
         def ctx_fn(tokens, attn_mask, batch):
             n_local = batch["dones"][:, :-1].astype(jnp.float32).sum()
-            return {"n": jnp.maximum(jax.lax.psum(n_local, "data"), 1.0)}
+            # ("data", "sequence"): sequence is size 1 (SP refuses ILQL x
+            # 1f1b) but still manual — see pipelined_ppo_trainer.ctx_fn
+            return {
+                "n": jnp.maximum(
+                    jax.lax.psum(n_local, ("data", "sequence")), 1.0
+                )
+            }
 
         def loss_mb(rest, heads, h, tok, mask, mb, ctx):
             logits, h_final = model.apply({"params": rest}, h, method=model.unembed)

@@ -32,33 +32,51 @@ from trlx_tpu.utils import logging
 logger = logging.get_logger(__name__)
 
 
+def _pad_seq(x, rem: int):
+    """THE sequence-divisibility padding: trailing zero columns on dim 1
+    (mask 0 / invalid targets, so losses ignore them by construction).
+    Shared by the GPipe forward wrapper and the 1F1B grad_fn so the
+    forward and grad paths cannot diverge."""
+    return jnp.pad(x, ((0, 0), (0, rem)) + ((0, 0),) * (x.ndim - 2))
+
+
 def causal_ce_1f1b_parts(model) -> Dict:
     """1F1B loss parts for the CE trainers (SFT/RFT): the per-microbatch
     decomposition of causal_lm_ce_loss — shift-CE summed over valid label
     positions, normalized by the GLOBAL valid count carried in ctx, so the
     summed microbatch contributions equal the batch-level loss exactly
-    (up to float reassociation)."""
+    (up to float reassociation).
+
+    The shift happens GLOBALLY in prepare() (targets/validity re-aligned
+    to the predicting position, full [B, t] width): the in-pipe loss then
+    only ever reads its own positions, which is what lets this compose
+    with sequence parallelism — a sequence shard never needs its
+    neighbor's labels, and zero-padded tail columns (SP divisibility
+    padding) are simply invalid."""
     from trlx_tpu.trainer.sft_trainer import ce_shift_labels_and_valid as _labels
     from trlx_tpu.utils.modeling import logprobs_of_labels
 
     def prepare(batch):
-        loss_batch = (
-            {"labels": batch["labels"]} if "labels" in batch else {}
-        )
-        return batch["input_ids"], batch["attention_mask"], loss_batch
+        tokens = batch["input_ids"]
+        attn_mask = batch["attention_mask"]
+        # the ONE definition of CE targets (shared with causal_lm_ce_loss),
+        # re-aligned to the predicting position and padded back to width t
+        shift_labels, valid = _labels(tokens, attn_mask, batch.get("labels"))
+        loss_batch = {
+            "ce_labels": jnp.pad(jnp.where(valid, shift_labels, 0), ((0, 0), (0, 1))),
+            "ce_valid": jnp.pad(valid.astype(jnp.int32), ((0, 0), (0, 1))),
+        }
+        return tokens, attn_mask, loss_batch
 
     def ctx_fn(tokens, attn_mask, batch):
-        _, valid = _labels(tokens, attn_mask, batch.get("labels"))
-        n = jax.lax.psum(valid.sum(), "data")
+        n = jax.lax.psum(batch["ce_valid"].sum(), ("data", "sequence"))
         return {"n": jnp.maximum(n, 1).astype(jnp.float32)}
 
     def loss_mb(rest, heads, h, tok, mask, mb_batch, ctx):
         del heads
         logits, _ = model.apply({"params": rest}, h, method=model.unembed)
-        shift_labels, valid = _labels(tok, mask, mb_batch.get("labels"))
-        safe_labels = jnp.where(valid, shift_labels, 0)
-        nll = -logprobs_of_labels(logits[:, :-1, :], safe_labels)
-        contrib = jnp.where(valid, nll, 0.0).sum() / ctx["n"]
+        nll = -logprobs_of_labels(logits, mb_batch["ce_labels"])
+        contrib = jnp.where(mb_batch["ce_valid"] > 0, nll, 0.0).sum() / ctx["n"]
         return contrib, {}
 
     return {
@@ -78,6 +96,13 @@ class PipelinedCausalMixin:
     # real query token and mask by the predicting position), so they keep
     # their left-padded collation.
     _sp_needs_right_padding = False
+    # Whether this trainer's 1F1B loss decomposition composes with
+    # sequence parallelism. CE trainers preshift targets globally so a
+    # shard never reads its neighbor's labels; PPO/ILQL window/gather
+    # per-sample slices that cross sequence shards. Checked at
+    # CONSTRUCTION (like the other PP x SP constraints) so an
+    # incompatible config fails before any rollout work.
+    _1f1b_supports_sequence = False
 
     def _validate_pipeline_config(self, config: TRLConfig) -> TRLConfig:
         """Validate (and possibly evolve) the config for the pipelined
@@ -108,6 +133,16 @@ class PipelinedCausalMixin:
                     "requires tokenizer.padding_side = 'right': the CE loss "
                     "reads the logit at the final pad position under left "
                     "padding, which has no valid context"
+                )
+            if (
+                getattr(config.parallel, "pipeline_schedule", "gpipe") == "1f1b"
+                and not self._1f1b_supports_sequence
+            ):
+                raise NotImplementedError(
+                    f"{type(self).__name__}'s 1F1B loss does not compose "
+                    "with sequence parallelism (per-sample windows/gathers "
+                    "cross sequence shards); use pipeline_schedule='gpipe' "
+                    "for PP x SP"
                 )
             extra["attn_impl"] = "ring"
             config = config.evolve(model=dict(model_extra_configs=extra))
@@ -298,8 +333,7 @@ class PipelinedCausalMixin:
             t = tokens.shape[1]
             rem = (-t) % seq_ways
             if rem:
-                tokens = jnp.pad(tokens, ((0, 0), (0, rem)))
-                attn_mask = jnp.pad(attn_mask, ((0, 0), (0, rem)))
+                tokens, attn_mask = _pad_seq(tokens, rem), _pad_seq(attn_mask, rem)
             out = fwd(stacked, rest, tokens, attn_mask)
             if with_hidden:
                 logits, h_final = out
@@ -346,8 +380,13 @@ class PipelinedCausalMixin:
 
         model = TransformerLM(self.model_cfg)
         parts = self.make_1f1b_loss_parts(model)
+        mesh = self.runtime.mesh
+        seq_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sequence", 1)
+        # _validate_pipeline_config already refused incompatible configs at
+        # construction; this is the defensive backstop for direct callers
+        assert seq_ways == 1 or self._1f1b_supports_sequence
         engine = make_1f1b_grad_fn(
-            model, self.model_cfg, self.runtime.mesh, self._n_microbatches,
+            model, self.model_cfg, mesh, self._n_microbatches,
             parts["loss_mb"], ctx_fn=parts.get("ctx_fn"),
             finalize_fn=parts.get("finalize_fn", default_finalize),
             freeze_split=self._freeze_split(),
@@ -362,6 +401,15 @@ class PipelinedCausalMixin:
                 if k not in ("lm_stacked", "lm_rest")
             }
             tokens, attn_mask, loss_batch = prepare(batch)
+            t0 = tokens.shape[1]
+            rem = (-t0) % seq_ways
+            if rem:
+                tokens, attn_mask = _pad_seq(tokens, rem), _pad_seq(attn_mask, rem)
+                loss_batch = jax.tree_util.tree_map(
+                    lambda x: _pad_seq(x, rem)
+                    if x.ndim >= 2 and x.shape[1] == t0 else x,
+                    loss_batch,
+                )
             loss, stats, (d_stacked, d_rest, d_heads) = engine(
                 params["lm_stacked"], params["lm_rest"], heads,
                 tokens, attn_mask, loss_batch,

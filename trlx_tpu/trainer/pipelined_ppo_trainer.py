@@ -174,8 +174,13 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             start = batch["query"].shape[1] - 1
             L = batch["old_logprobs"].shape[1]
             m = attn_mask[:, start + 1 : start + L + 1]
+            # ("data", "sequence"): the sequence axis is size 1 here (SP
+            # refuses PPO x 1f1b) but still MANUAL, so n must be reduced
+            # over it or every stat divided by n stays sequence-varying
+            # and violates the replicated out_specs
             n = jnp.maximum(
-                jax.lax.psum(m.sum(), "data").astype(jnp.float32), 1.0
+                jax.lax.psum(m.sum(), ("data", "sequence")).astype(jnp.float32),
+                1.0,
             )
             return {"n": n, "size": float(tokens.shape[0] * data_ways * L)}
 

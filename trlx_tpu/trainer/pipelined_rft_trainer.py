@@ -19,6 +19,7 @@ from trlx_tpu.trainer.sft_trainer import causal_lm_ce_loss
 @register_trainer
 class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
     _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
+    _1f1b_supports_sequence = True  # CE targets preshift globally
 
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)

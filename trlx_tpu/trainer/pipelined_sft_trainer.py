@@ -40,6 +40,7 @@ logger = logging.get_logger(__name__)
 @register_trainer
 class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
     _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
+    _1f1b_supports_sequence = True  # CE targets preshift globally
 
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)

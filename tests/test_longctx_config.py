@@ -94,3 +94,60 @@ def test_32k_sp_config_lowers_bf16(sp_setup):
         assert _lowered_loss(config, runtime, "bfloat16") is not None
     finally:
         os.environ.pop("TRLX_ALLOW_CPU_BF16_PARTIAL", None)
+
+
+def test_16k_pp_sp_1f1b_config_traces():
+    """The shipped deep-model x long-context preset
+    (configs/sft_long_context_pp_sp_1f1b.yml: llama-7b, seq 16384,
+    pipeline x sequence under the 1F1B schedule) traces its hand-scheduled
+    value-and-grad with ABSTRACT params on the folded 8-device mesh — the
+    shape/sharding contract of the whole engine at real scale, with no 7B
+    materialization."""
+    from trlx_tpu.models import config_from_preset
+    from trlx_tpu.models.transformer import TransformerLM
+    from trlx_tpu.parallel.onef1b import make_1f1b_grad_fn
+    from trlx_tpu.parallel.pipeline import make_pipe_mesh, stack_block_params
+    from trlx_tpu.trainer.pipelined_mixin import causal_ce_1f1b_parts
+
+    with open(
+        os.path.join(REPO, "configs", "sft_long_context_pp_sp_1f1b.yml")
+    ) as f:
+        config = TRLConfig.from_dict(yaml.safe_load(f))
+    T = config.train.seq_length
+    assert T == 16384
+    assert config.parallel.pipeline_schedule == "1f1b"
+    # 16-chip preset folded to 8 devices: data 1 x pipe 2 x fsdp 2 x seq 2
+    mesh = make_pipe_mesh(2, fsdp=2, sequence=2)
+    cfg = config_from_preset(
+        "llama-7b", vocab_size=259, max_seq_len=T, dtype="float32",
+        param_dtype="float32", attn_impl="ring",
+        **dict(config.model.model_extra_configs or {}),
+    )
+    model = TransformerLM(cfg)
+    abstract = jax.eval_shape(
+        lambda rng: model.init(rng, jnp.zeros((1, 128), jnp.int32),
+                               jnp.ones((1, 128), jnp.int32))["params"],
+        jax.random.PRNGKey(0),
+    )
+    stacked, rest = jax.eval_shape(
+        lambda p: stack_block_params(p, cfg.n_layers, 2), abstract
+    )
+    parts = causal_ce_1f1b_parts(model)
+    engine = make_1f1b_grad_fn(
+        model, cfg, mesh, n_microbatches=2, loss_mb=parts["loss_mb"],
+        ctx_fn=parts["ctx_fn"],
+    )
+
+    def run(stacked, rest, tokens, mask):
+        toks, m, loss_batch = parts["prepare"](
+            {"input_ids": tokens, "attention_mask": mask}
+        )
+        return engine(stacked, rest, {}, toks, m, loss_batch)
+
+    B = config.train.batch_size
+    tok = jax.ShapeDtypeStruct((B, T), jnp.int32)
+    out = jax.eval_shape(run, stacked, rest, tok, tok)
+    loss_shape, _, (d_stacked, d_rest, _) = out
+    assert loss_shape.shape == ()
+    assert jax.tree_util.tree_structure(d_stacked) == jax.tree_util.tree_structure(stacked)
+    assert jax.tree_util.tree_structure(d_rest) == jax.tree_util.tree_structure(rest)

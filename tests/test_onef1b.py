@@ -122,13 +122,14 @@ def test_grad_parity_with_freeze_split():
     assert all(float(jnp.abs(l).max()) == 0.0 for l in frozen_leaves)
 
 
-def test_grad_parity_with_tensor_axis():
-    """1F1B with a GSPMD-auto tensor axis inside the manual program
-    (TP x PP composition): the hand vjps must transpose correctly through
-    the auto-sharded stage matmuls. f32 (XLA:CPU bf16 partial-manual
-    limitation, parallel/context.py)."""
+@pytest.mark.parametrize("axes", [dict(tensor=2), dict(fsdp=2)])
+def test_grad_parity_with_tensor_axis(axes):
+    """1F1B with a GSPMD-auto tensor/fsdp axis inside the manual program
+    (TP x PP / ZeRO x PP composition): the hand vjps must transpose
+    correctly through the auto-sharded stage matmuls. f32 (XLA:CPU bf16
+    partial-manual limitation, parallel/context.py)."""
     cfg, model, mesh, stacked, rest, tokens, mask = _setup()
-    mesh_tp = make_pipe_mesh(2, tensor=2)
+    mesh_tp = make_pipe_mesh(2, **axes)
     l0, g0 = _gpipe_loss_and_grads(cfg, model, mesh_tp, stacked, rest, tokens, mask, 2)
     l1, (ds, dr) = _onef1b_loss_and_grads(cfg, model, mesh_tp, stacked, rest, tokens, mask, 2)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l0), rtol=1e-6)

@@ -259,6 +259,60 @@ def test_pipelined_sft_trainer_1f1b(tmp_path):
     _flat_close(g1, g0)
 
 
+def test_pipelined_sft_trainer_1f1b_lora(tmp_path):
+    """LoRA through the 1F1B schedule: adapters are separate stacked
+    leaves, the pipeline must not stop_gradient anything (LoRA split-0 is
+    a hydra concern, not a freeze boundary), and the train-key grads
+    (adapter leaves only) match autodiff of the GPipe loss."""
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    config = default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   peft_config=dict(peft_type="LORA", r=4, lora_alpha=8,
+                                    target_modules=["q_proj", "v_proj"]),
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=10, checkpoint_interval=100,
+                   trainer="PipelinedSFTTrainer",
+                   checkpoint_dir=str(tmp_path / "lora1f1b"), seed=11),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+        parallel=dict(data=4, fsdp=1, tensor=1, pipeline=2,
+                      pipeline_schedule="1f1b"),
+    )
+    samples = ["hello world this is text", "another training sample here"] * 8
+    trainer = trlx.train(samples=samples, eval_prompts=["hello"], config=config)
+    assert trainer.iter_count >= 2
+    # adapter-only training partition
+    assert all(
+        "lora" in "/".join(map(str, k)).lower() for k in trainer.train_params
+    )
+
+    batch = trainer.batch_to_device(
+        next(iter(trainer.store.create_loader(8, shuffle=False)))
+    )
+    grad_fn = jax.jit(trainer.make_grad_fn())
+    loss_fn = trainer.make_loss_fn()
+
+    def ref(train_params, frozen_params, batch):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_params, frozen_params, batch
+        )
+        return loss, stats, grads
+
+    l1, _, g1 = grad_fn(trainer.train_params, trainer.frozen_params, batch)
+    l0, _, g0 = jax.jit(ref)(trainer.train_params, trainer.frozen_params, batch)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    _flat_close(g1, g0)
+    # gradients actually reach the adapters (B starts at zero, so A-grads
+    # would vanish if the adapter path were dead — check the B side)
+    assert any(
+        float(jnp.abs(v).max()) > 0
+        for k, v in g1.items() if "lora_b" in "/".join(map(str, k)).lower()
+    )
+
+
 def test_pipelined_ppo_trainer_1f1b(tmp_path):
     """PipelinedPPOTrainer under the 1F1B schedule: full PPO cycle
     end-to-end, plus grad AND stats parity of the per-microbatch

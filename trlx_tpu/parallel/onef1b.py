@@ -32,8 +32,9 @@ stashes only its INPUT activation per in-flight microbatch (a ring buffer
 of min(2S-1, M) slots) and the backward tick recomputes the stage forward
 under `jax.vjp` — the same recompute cost autodiff-with-remat pays, but
 with residual lifetime bounded by the schedule instead of the scan.
-Gradients accumulate in the scan carry; the final psum over ("data",
-"pipe") replaces the transpose-inserted collectives of the autodiff path.
+Gradients accumulate in the scan carry; the final psum over the data
+(and, under PP x SP, sequence) axes replaces the transpose-inserted
+collectives of the autodiff path.
 
 There is no NCCL/MPI or Apex machinery to port: the schedule is pure
 `lax.scan` + two `ppermute`s per tick, and XLA overlaps the permutes with
@@ -42,11 +43,10 @@ stage matmuls and their vjps shard exactly as in the GPipe engine.
 """
 
 import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -99,7 +99,7 @@ def masked_sums(x, m):
 
 def gated_reducers(gate):
     """(gsum, gmin, gmax) over the [n_ticks] stat bank: gated to the
-    real last-stage ticks and reduced over ("data", "pipe")."""
+    real last-stage ticks and reduced over GRAD_AXES."""
 
     def gsum(leaf):
         return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
@@ -127,16 +127,14 @@ def finalize_tensor_stats(d, n, gsum, gmin, gmax):
 
 
 def default_finalize(tick_stats, gate, ctx):
-    """Sum-decomposed stats: every leaf is a per-microbatch SUM contribution;
-    the final stat is the global sum (pipe+data psum of the gated tick sums).
-    Losses normalized inside loss_mb (divide by a ctx-borne global count)
-    therefore come out exactly equal to the batch-level computation."""
+    """Sum-decomposed stats: every leaf is a per-microbatch SUM
+    contribution; the final stat is the GRAD_AXES psum of the gated tick
+    sums. Losses normalized inside loss_mb (divide by a ctx-borne global
+    count) therefore come out exactly equal to the batch-level
+    computation."""
     del ctx
-
-    def _one(leaf):
-        return jax.lax.psum(jnp.where(gate, leaf, 0.0).sum(), GRAD_AXES)
-
-    return jax.tree_util.tree_map(_one, tick_stats)
+    gsum, _, _ = gated_reducers(gate)
+    return jax.tree_util.tree_map(gsum, tick_stats)
 
 
 def make_1f1b_grad_fn(
@@ -166,18 +164,18 @@ def make_1f1b_grad_fn(
 
     `loss_mb` returns this microbatch's CONTRIBUTION to the final scalar
     loss (normalize by a global count carried in `ctx` — computed once by
-    `ctx_fn`, which may psum over "data") plus a pytree of per-microbatch
-    stat scalars; `finalize_fn` reduces the [n_ticks] bank of those into
-    the final stats dict (`default_finalize` = gated global sums).
+    `ctx_fn`, which may psum over ("data", "sequence")) plus a pytree of
+    per-microbatch stat scalars; `finalize_fn` reduces the [n_ticks] bank
+    of those into the final stats dict (`default_finalize` = gated global
+    sums).
 
     The returned loss/stats are replicated; d_stacked keeps the stacked
-    sharding; d_rest/d_heads are psummed over ("data", "pipe") — embed
-    grads arrive from stage 0, unembed/head grads from stage S-1, and
-    tied embeddings correctly receive both contributions.
+    sharding; d_rest/d_heads are psummed over GRAD_AXES — embed grads
+    arrive from stage 0, unembed/head grads from stage S-1, and tied
+    embeddings correctly receive both contributions.
     """
     mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = mesh_shape[PIPE_AXIS]
-    data_ways = mesh_shape.get("data", 1)
     M = int(n_microbatches)
     RS = min(2 * S - 1, M)  # ring-stash slots; in-flight span at stage i is
     # 2(S-i)-1, and valid (f, b) pairs obey f - b = 2S-2-2i < RS, so slot

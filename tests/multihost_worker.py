@@ -81,6 +81,28 @@ def main():
     results = trainer.evaluate()
     reward_mean = results.get("reward/mean", -1.0)
 
+    # pipelined 1F1B across the SAME cluster: the hand-scheduled engine's
+    # ppermutes/psums must behave identically when the mesh spans real
+    # processes (pipe pairs and data groups may straddle the process
+    # boundary) — one SFT train step, loss must be host-identical
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.pipelined_sft_trainer import PipelinedSFTTrainer
+
+    sft_config = default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, tracker=None, seed=7),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+        parallel=dict(data=4, pipeline=2, pipeline_schedule="1f1b"),
+    )
+    sft = PipelinedSFTTrainer(sft_config)
+    sft.make_experience(["multi host pipelined text"] * 8, 32)
+    sft_loss = None
+    for mb in MiniBatchIterator(sft.create_train_dataloader(), sft.mb_size, sft.num_mb):
+        sft_loss = float(np.asarray(sft.train_minibatch(mb)["loss"]))
+        break
+
     print(json.dumps({
         "marker": "MULTIHOST_OK",
         "proc": int(sys.argv[3]),
@@ -89,6 +111,7 @@ def main():
         "loss": round(loss, 6),
         "mean_kl": round(float(trainer.mean_kl), 6),
         "reward_mean": round(float(reward_mean), 4),
+        "pp_1f1b_loss": round(sft_loss, 6),
     }), flush=True)
 
 

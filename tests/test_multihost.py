@@ -61,3 +61,5 @@ def test_two_process_ppo_cycle():
     assert a["store_fingerprint"] == b["store_fingerprint"]
     assert a["loss"] == b["loss"]
     assert a["mean_kl"] == b["mean_kl"]
+    # the hand-scheduled 1F1B pipeline step over the same 2-process mesh
+    assert a["pp_1f1b_loss"] == b["pp_1f1b_loss"]

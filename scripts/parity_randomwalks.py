@@ -1,0 +1,545 @@
+"""End-to-end quality parity: our trainers vs the ACTUAL reference trlx
+trainers on the reference's own CPU-able benchmark (randomwalks).
+
+This closes the north star's second metric (BASELINE.md "Reward@step curve
+... parity with AcceleratePPOTrainer"): both frameworks train from the SAME
+exported checkpoint, on the SAME task instance (built by the reference's own
+examples/randomwalks/randomwalks.py generator, imported by file path), with
+the SAME hyperparameters (the reference example's,
+examples/randomwalks/ppo_randomwalks.py:13-52), and the reward/metric
+curves are captured identically on both sides by wrapping the task fns.
+
+Stages (all driven by `python scripts/parity_randomwalks.py all`):
+  prepare    — reference task; warm-start SFT in OUR framework (the role of
+               the CarperAI/randomwalks hub checkpoint, which is
+               unreachable offline); export HF checkpoint + tokenizer.
+  ref-ppo    — reference AcceleratePPOTrainer (torch CPU), PYTHONPATH'd to
+               /root/reference with the import shims in scripts/ref_shims.
+  ours-ppo   — our PPOTrainer, same config, on whatever jax backend exists.
+  ref-ilql / ours-ilql — same for ILQL (offline method), from the same
+               checkpoint, reference example hparams
+               (examples/randomwalks/ilql_randomwalks.py:35-62).
+  compare    — align curves, write PARITY_CURVES.json at the repo root.
+
+The committed PARITY_CURVES.json is asserted by tests/test_parity_curves.py.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+SHIMS = os.path.join(REPO, "scripts", "ref_shims")
+WORKDIR = os.path.join(REPO, "logs", "parity_randomwalks")
+CKPT = os.path.join(WORKDIR, "ckpt")
+ALPHABET = "abcdefghijklmnopqrstu"  # 21 nodes, ids 0..20; pad 21 bos 22 eos 23
+
+# Reference example hparams (examples/randomwalks/ppo_randomwalks.py:13-52),
+# sized up from epochs=20 to 64 outer iterations (~512 optimizer steps) so
+# the asymptote is measured, not the transient.
+PPO_EPOCHS_OUTER = 64
+PPO_EVAL_INTERVAL = 16
+ILQL_EPOCHS = 24
+ILQL_EVAL_INTERVAL = 16
+SEED = 1000
+EVAL_REPEATS = 8  # each unique start node appears 8x in eval_prompts
+
+
+def load_reference_task(seed=1002):
+    """Import the reference's own task generator by file path (package names
+    collide with ours); returns (metric_fn, eval_prompts, walks)."""
+    spec = importlib.util.spec_from_file_location(
+        "ref_randomwalks",
+        os.path.join(REFERENCE, "examples", "randomwalks", "randomwalks.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    metric_fn, eval_prompts, walks, _logit_mask = mod.generate_random_walks(seed=seed)
+    return metric_fn, eval_prompts, walks
+
+
+class CurveRecorder:
+    """Wraps the task's reward/metric fns, appending one JSONL row per call
+    so both frameworks' curves are captured by the exact same probe."""
+
+    def __init__(self, path: str, metric_fn):
+        self.path = path
+        self.metric = metric_fn
+        self.n_reward_calls = 0
+        self.n_eval_calls = 0
+        self.samples_seen = 0
+        self.t0 = time.time()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        open(path, "w").close()
+
+    def _log(self, row):
+        row["t"] = round(time.time() - self.t0, 2)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    def reward_fn(self, samples, **kwargs):
+        scores = self.metric(samples)["optimality"]
+        vals = [float(s) for s in scores]
+        self.samples_seen += len(vals)
+        self._log({
+            "kind": "reward", "call": self.n_reward_calls,
+            "samples_seen": self.samples_seen,
+            "mean": sum(vals) / max(len(vals), 1),
+        })
+        self.n_reward_calls += 1
+        return scores
+
+    def metric_fn(self, samples, **kwargs):
+        out = self.metric(samples)
+        vals = [float(v) for v in out["optimality"]]
+        self._log({
+            "kind": "eval", "call": self.n_eval_calls,
+            "optimality_mean": sum(vals) / max(len(vals), 1),
+            "n": len(vals),
+        })
+        self.n_eval_calls += 1
+        return out
+
+    def close(self):
+        pass
+
+
+def eval_prompt_list(eval_prompts):
+    return sorted(eval_prompts) * EVAL_REPEATS
+
+
+# ---------------------------------------------------------------- prepare
+
+def cmd_prepare(args):
+    """Warm-start SFT on the reference task's sample walks with OUR
+    framework; export the checkpoint HF-style (pytorch_model.bin +
+    config.json + tokenizer files). Both frameworks then start PPO/ILQL
+    from this identical init."""
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    _metric_fn, eval_prompts, walks = load_reference_task()
+
+    sft_config = default_sft_config().evolve(
+        model=dict(
+            model_path="random:gpt2-tiny",
+            num_layers_unfrozen=-1,
+            # the size of the reference's own from-scratch stand-in for the
+            # CarperAI/randomwalks checkpoint (ilql_randomwalks.py:25)
+            model_extra_configs=dict(
+                d_model=144, n_layers=6, n_heads=12, d_ff=576, max_seq_len=64
+            ),
+        ),
+        tokenizer=dict(tokenizer_path=f"char:{ALPHABET}"),
+        train=dict(
+            seq_length=10, batch_size=100,
+            total_steps=args.warm_steps, epochs=max(args.warm_steps, 1),
+            eval_interval=10**9, checkpoint_interval=10**9,
+            tracker=None, seed=SEED,
+            checkpoint_dir=os.path.join(WORKDIR, "warm_sft"),
+        ),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+    )
+    trainer = trlx.train(samples=list(walks), eval_prompts=sorted(eval_prompts)[:4],
+                         config=sft_config)
+    trainer.save_pretrained(CKPT)
+    print(f"[prepare] checkpoint + tokenizer exported to {CKPT}")
+    print(f"[prepare] files: {sorted(os.listdir(CKPT))}")
+
+
+# ------------------------------------------------------------- reference
+
+def _force_eager_attention():
+    """The installed transformers (4.57) refuses to construct the
+    reference's custom PreTrainedModel subclasses (GPTModelBranch etc.)
+    under the default sdpa attention dispatch; the reference predates that
+    check. Force eager attention at the loader so branch configs inherit
+    it — numerics are identical, only the torch kernel choice differs
+    (eager_attention_forward still applies the module-internal causal
+    mask, modeling_gpt2.py:125-133 in the installed tree). Also default
+    config.use_cache=False: the reference branch forward collects
+    old-style `presents` tuples (modeling_ppo.py:651-652) that the new
+    Cache-API blocks no longer return."""
+    import transformers
+
+    for cls in (transformers.AutoModelForCausalLM, transformers.AutoModelForSeq2SeqLM):
+        orig = cls.from_pretrained.__func__
+
+        def patched(c, *a, _orig=orig, **kw):
+            kw.setdefault("attn_implementation", "eager")
+            kw.setdefault("use_cache", False)
+            return _orig(c, *a, **kw)
+
+        cls.from_pretrained = classmethod(patched)
+
+    # the installed safetensors refuses GPT-2's tied wte/lm_head at
+    # accelerator.save_state (end-of-learn checkpoint); use torch
+    # serialization, which handles shared storage
+    from accelerate import Accelerator
+
+    orig_save = Accelerator.save_state
+
+    def save_state(self, output_dir=None, **kw):
+        kw["safe_serialization"] = False
+        return orig_save(self, output_dir, **kw)
+
+    Accelerator.save_state = save_state
+
+
+def _reference_ppo_config(trlx_mod):
+    from trlx.data.default_configs import (
+        ModelConfig, OptimizerConfig, PPOConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+
+    return TRLConfig(
+        train=TrainConfig(
+            seq_length=10, epochs=PPO_EPOCHS_OUTER, total_steps=100000,
+            batch_size=100, checkpoint_interval=10**8,
+            eval_interval=PPO_EVAL_INTERVAL,
+            pipeline="PromptPipeline", trainer="AcceleratePPOTrainer",
+            checkpoint_dir=os.path.join(WORKDIR, "ref_ppo_ckpt"),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=CKPT, truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)
+        ),
+        method=PPOConfig(
+            name="PPOConfig", num_rollouts=128, chunk_size=128, ppo_epochs=4,
+            init_kl_coef=0, target=None, horizon=10000, gamma=1, lam=0.95,
+            cliprange=0.2, cliprange_value=0.2, vf_coef=1.2,
+            scale_reward="ignored", ref_mean=None, ref_std=None,
+            cliprange_reward=1,
+            gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        ),
+    )
+
+
+def cmd_ref_ppo(args):
+    _force_eager_attention()
+    import trlx  # resolved to /root/reference via PYTHONPATH
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ref_ppo.curve.jsonl"), metric_fn)
+    config = _reference_ppo_config(trlx)
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ref-ppo] wrote {rec.path}: {rec.n_eval_calls} evals, "
+          f"{rec.n_reward_calls} reward calls")
+
+
+def cmd_ref_ilql(args):
+    _force_eager_attention()
+    import trlx
+
+    from trlx.data.default_configs import (
+        ILQLConfig, ModelConfig, OptimizerConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+
+    metric_fn, eval_prompts, walks = load_reference_task()
+    rewards = metric_fn(walks)["optimality"]
+    samples = [[w[:1], w[1:]] for w in walks]
+    rec = CurveRecorder(os.path.join(WORKDIR, "ref_ilql.curve.jsonl"), metric_fn)
+
+    config = TRLConfig(
+        train=TrainConfig(
+            seq_length=11, batch_size=100, epochs=ILQL_EPOCHS, total_steps=100000,
+            checkpoint_interval=10**8, eval_interval=ILQL_EVAL_INTERVAL,
+            pipeline="PromptPipeline", trainer="AccelerateILQLTrainer",
+            checkpoint_dir=os.path.join(WORKDIR, "ref_ilql_ckpt"),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=CKPT, truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs=dict(lr=2e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=2e-4)
+        ),
+        method=ILQLConfig(
+            name="ilqlconfig", tau=0.8, gamma=0.99, cql_scale=0.1, awac_scale=1,
+            alpha=0.1, beta=0, steps_for_target_q_sync=5, two_qs=True,
+            gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=[1], temperature=1.0),
+        ),
+    )
+    trlx.train(
+        samples=samples, rewards=rewards,
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ref-ilql] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+# ------------------------------------------------------------------ ours
+
+def cmd_ours_ppo(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+    from trlx_tpu.data.configs import (
+        ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.trainer.ppo_trainer import PPOConfig
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_ppo.curve.jsonl"), metric_fn)
+
+    config = TRLConfig(
+        train=TrainConfig(
+            seq_length=10, epochs=PPO_EPOCHS_OUTER, total_steps=100000,
+            batch_size=100, checkpoint_interval=10**8,
+            eval_interval=PPO_EVAL_INTERVAL,
+            pipeline="PromptPipeline", trainer="PPOTrainer",
+            checkpoint_dir=os.path.join(WORKDIR, "ours_ppo_ckpt"),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char:{ALPHABET}",
+                                  truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)
+        ),
+        method=PPOConfig(
+            name="PPOConfig", num_rollouts=128, chunk_size=128, ppo_epochs=4,
+            init_kl_coef=0, target=None, horizon=10000, gamma=1, lam=0.95,
+            cliprange=0.2, cliprange_value=0.2, vf_coef=1.2,
+            scale_reward="ignored", ref_mean=None, ref_std=None,
+            cliprange_reward=1,
+            gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        parallel=ParallelConfig(),
+    )
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-ppo] wrote {rec.path}: {rec.n_eval_calls} evals, "
+          f"{rec.n_reward_calls} reward calls")
+
+
+def cmd_ours_ilql(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+    from trlx_tpu.data.configs import (
+        ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.trainer.ilql_trainer import ILQLConfig
+
+    metric_fn, eval_prompts, walks = load_reference_task()
+    rewards = metric_fn(walks)["optimality"]
+    samples = [[w[:1], w[1:]] for w in walks]
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_ilql.curve.jsonl"), metric_fn)
+
+    config = TRLConfig(
+        train=TrainConfig(
+            seq_length=11, batch_size=100, epochs=ILQL_EPOCHS, total_steps=100000,
+            checkpoint_interval=10**8, eval_interval=ILQL_EVAL_INTERVAL,
+            pipeline="PromptPipeline", trainer="ILQLTrainer",
+            checkpoint_dir=os.path.join(WORKDIR, "ours_ilql_ckpt"),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char:{ALPHABET}",
+                                  truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs=dict(lr=2e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=2e-4)
+        ),
+        method=ILQLConfig(
+            name="ilqlconfig", tau=0.8, gamma=0.99, cql_scale=0.1, awac_scale=1,
+            alpha=0.1, beta=0, steps_for_target_q_sync=5, two_qs=True,
+            gen_kwargs=dict(max_new_tokens=9, top_k=10, beta=[1], temperature=1.0),
+        ),
+        parallel=ParallelConfig(),
+    )
+    trlx.train(
+        samples=samples, rewards=rewards,
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-ilql] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+# --------------------------------------------------------------- compare
+
+def _load_curve(path):
+    evals, rewards = [], []
+    with open(path) as f:
+        for line in f:
+            row = json.loads(line)
+            if row["kind"] == "eval":
+                evals.append(row["optimality_mean"])
+            else:
+                rewards.append((row["samples_seen"], row["mean"]))
+    return evals, rewards
+
+
+def _summary(vals):
+    tail = vals[(len(vals) * 3) // 4:] if len(vals) > 3 else vals
+    return {
+        "final": vals[-1],
+        "best": max(vals),
+        "mean_last_quarter": sum(tail) / len(tail),
+        "n_points": len(vals),
+    }
+
+
+def cmd_compare(args):
+    out = {
+        "task": "randomwalks (reference examples/randomwalks/randomwalks.py, seed 1002)",
+        "checkpoint": "shared warm-start SFT export (prepare stage)",
+        "metric": "optimality in [0,1] of sampled paths vs shortest path, "
+                  "mean over eval prompts (each start node x%d)" % EVAL_REPEATS,
+        "config": {
+            "ppo": "reference examples/randomwalks/ppo_randomwalks.py hparams, "
+                   f"epochs={PPO_EPOCHS_OUTER}, eval_interval={PPO_EVAL_INTERVAL}",
+            "ilql": "reference examples/randomwalks/ilql_randomwalks.py hparams, "
+                    f"epochs={ILQL_EPOCHS}, eval_interval={ILQL_EVAL_INTERVAL}, beta=[1]",
+        },
+        "methods": {},
+    }
+    ok = True
+    for method in ("ppo", "ilql"):
+        ref_path = os.path.join(WORKDIR, f"ref_{method}.curve.jsonl")
+        ours_path = os.path.join(WORKDIR, f"ours_{method}.curve.jsonl")
+        if not (os.path.exists(ref_path) and os.path.exists(ours_path)):
+            # refuse rather than clobber the committed artifact with an
+            # empty comparison
+            raise SystemExit(
+                f"[compare] missing curves for {method} "
+                f"({ref_path} / {ours_path}); run the training stages first"
+            )
+        ref_evals, ref_rewards = _load_curve(ref_path)
+        ours_evals, ours_rewards = _load_curve(ours_path)
+        rs, os_ = _summary(ref_evals), _summary(ours_evals)
+        entry = {
+            "reference": {"trainer": f"Accelerate{method.upper()}Trainer",
+                          "eval_curve": [round(v, 4) for v in ref_evals],
+                          "reward_curve": [[n, round(v, 4)] for n, v in ref_rewards],
+                          **{k: round(v, 4) if isinstance(v, float) else v
+                             for k, v in rs.items()}},
+            "ours": {"trainer": f"{method.upper()}Trainer",
+                     "eval_curve": [round(v, 4) for v in ours_evals],
+                     "reward_curve": [[n, round(v, 4)] for n, v in ours_rewards],
+                     **{k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in os_.items()}},
+            "delta_final": round(os_["final"] - rs["final"], 4),
+            "delta_mean_last_quarter": round(
+                os_["mean_last_quarter"] - rs["mean_last_quarter"], 4),
+        }
+        out["methods"][method] = entry
+        print(f"[compare] {method}: ref final {rs['final']:.3f} "
+              f"(last-q {rs['mean_last_quarter']:.3f}) | ours final {os_['final']:.3f} "
+              f"(last-q {os_['mean_last_quarter']:.3f}) | "
+              f"delta last-q {entry['delta_mean_last_quarter']:+.3f}")
+        if entry["delta_mean_last_quarter"] < -0.05:
+            ok = False
+    dest = os.path.join(REPO, "PARITY_CURVES.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"[compare] wrote {dest}; parity {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
+# ------------------------------------------------------------------- all
+
+def _run_stage(stage, env_extra=None, timeout=7200):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    print(f"[all] === stage {stage} ===", flush=True)
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), stage],
+        env=env, timeout=timeout, cwd=REPO,
+    )
+    print(f"[all] stage {stage} rc={proc.returncode} in {time.time()-t0:.0f}s",
+          flush=True)
+    if proc.returncode != 0:
+        raise SystemExit(f"stage {stage} failed (rc={proc.returncode})")
+
+
+def _pythonpath(*prefix):
+    # prepend, preserving the ambient path (it carries the TPU plugin)
+    inherited = os.environ.get("PYTHONPATH", "")
+    return ":".join([*prefix] + ([inherited] if inherited else []))
+
+
+def cmd_all(args):
+    ref_env = {
+        "PYTHONPATH": _pythonpath(SHIMS, REFERENCE),
+        "TRANSFORMERS_OFFLINE": "1", "HF_HUB_OFFLINE": "1",
+        # keep torch off every accelerator plumbing path
+        "CUDA_VISIBLE_DEVICES": "",
+        "TOKENIZERS_PARALLELISM": "false",
+    }
+    ours_env = {"PYTHONPATH": _pythonpath(REPO),
+                "TRANSFORMERS_OFFLINE": "1", "HF_HUB_OFFLINE": "1"}
+    if not os.path.exists(os.path.join(CKPT, "pytorch_model.bin")) or args.force:
+        _run_stage("prepare", ours_env)
+    for stage, env in (
+        ("ref-ppo", ref_env), ("ours-ppo", ours_env),
+        ("ref-ilql", ref_env), ("ours-ilql", ours_env),
+    ):
+        if args.only and stage not in args.only:
+            continue
+        _run_stage(stage, env)
+    raise SystemExit(cmd_compare(args))
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("stage", choices=[
+        "prepare", "ref-ppo", "ours-ppo", "ref-ilql", "ours-ilql",
+        "compare", "all",
+    ])
+    parser.add_argument("--warm-steps", type=int, default=100)
+    parser.add_argument("--force", action="store_true",
+                        help="redo the prepare stage even if the ckpt exists")
+    parser.add_argument("--only", nargs="*", default=None,
+                        help="run only these stages (with `all`)")
+    args = parser.parse_args()
+    cmd = {
+        "prepare": cmd_prepare, "ref-ppo": cmd_ref_ppo, "ours-ppo": cmd_ours_ppo,
+        "ref-ilql": cmd_ref_ilql, "ours-ilql": cmd_ours_ilql,
+        "compare": cmd_compare, "all": cmd_all,
+    }[args.stage]
+    rc = cmd(args)
+    if isinstance(rc, int):
+        raise SystemExit(rc)
+
+
+if __name__ == "__main__":
+    main()

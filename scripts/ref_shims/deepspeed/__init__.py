@@ -1,0 +1,22 @@
+"""Minimal stand-in for `deepspeed` (not installed) so the reference trlx
+tree imports for offline CPU parity runs. The reference only touches
+`zero.GatheredParameters` (a no-op context outside ZeRO-3) and
+`comm.get_rank` on this code path; no ZeRO is active in these runs."""
+import contextlib
+
+
+class _Zero:
+    @staticmethod
+    @contextlib.contextmanager
+    def GatheredParameters(params, modifier_rank=None, enabled=True):
+        yield
+
+
+class _Comm:
+    @staticmethod
+    def get_rank():
+        return 0
+
+
+zero = _Zero()
+comm = _Comm()

@@ -1,0 +1,18 @@
+"""Compatibility shim: the installed peft renamed
+prepare_model_for_int8_training -> prepare_model_for_kbit_training, but the
+reference trlx imports the old name. Load the real peft from site-packages
+and alias the old name onto it (self-replacing module pattern)."""
+import os
+import sys
+
+_shim_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_saved_path = list(sys.path)
+sys.path = [p for p in sys.path if os.path.abspath(p or ".") != _shim_dir]
+del sys.modules["peft"]
+try:
+    import peft as _real
+finally:
+    sys.path = _saved_path
+if not hasattr(_real, "prepare_model_for_int8_training"):
+    _real.prepare_model_for_int8_training = _real.prepare_model_for_kbit_training
+sys.modules["peft"] = _real

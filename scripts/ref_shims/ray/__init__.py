@@ -1,0 +1,6 @@
+"""Stand-in for `ray` (not installed): the reference trainer only calls
+ray.is_initialized() to gate Ray-Tune reporting, which is never active in
+the offline parity runs."""
+
+def is_initialized():
+    return False

@@ -1,0 +1,21 @@
+"""Stand-in for `wandb` (not installed). The reference imports it at module
+scope in accelerate_rft_trainer; all actual use is gated behind
+tracker == "wandb", which the offline parity runs never set."""
+
+
+class Table:
+    def __init__(self, columns=None, rows=None, data=None, **kwargs):
+        self.columns, self.rows, self.data = columns, rows, data
+
+
+class Histogram:
+    def __init__(self, sequence=None, num_bins=64, **kwargs):
+        self.sequence, self.num_bins = sequence, num_bins
+
+
+def init(*args, **kwargs):
+    raise RuntimeError("wandb shim: tracker 'wandb' is not available offline")
+
+
+def log(*args, **kwargs):
+    raise RuntimeError("wandb shim: tracker 'wandb' is not available offline")

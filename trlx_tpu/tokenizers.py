@@ -189,6 +189,41 @@ class CharTokenizer(BaseTokenizer):
                     chars.append(self.bos_token)
         return "".join(chars)
 
+    def save_pretrained(self, directory: str):
+        """Write an HF-loadable tokenizer with the SAME id layout (letters
+        0..n-1, pad=n, bos=n+1, eos=n+2), so checkpoints exported through
+        hf_interop are self-contained for `AutoTokenizer.from_pretrained`
+        (the role of the reference's hub tokenizer repos, e.g.
+        CarperAI/randomwalks in examples/randomwalks/ppo_randomwalks.py:25)."""
+        import json
+        import os
+
+        from tokenizers import Regex, Tokenizer, decoders, models, pre_tokenizers
+
+        vocab = {c: i for i, c in enumerate(self.alphabet)}
+        vocab["<pad>"] = self.pad_token_id
+        vocab[self.bos_token] = self.bos_token_id
+        vocab[self.eos_token] = self.eos_token_id
+        tok = Tokenizer(models.WordLevel(vocab, unk_token="<pad>"))
+        # char-level: every input character is its own token ((?s) so a
+        # newline in the alphabet still isolates); Fuse so decode
+        # concatenates without separators (metric fns parse char-by-char)
+        tok.pre_tokenizer = pre_tokenizers.Split(Regex("(?s)."), behavior="isolated")
+        tok.decoder = decoders.Fuse()
+        os.makedirs(directory, exist_ok=True)
+        tok.save(os.path.join(directory, "tokenizer.json"))
+        with open(os.path.join(directory, "tokenizer_config.json"), "w") as f:
+            json.dump({
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "pad_token": "<pad>", "bos_token": self.bos_token,
+                "eos_token": self.eos_token,
+                "padding_side": self.padding_side,
+                "truncation_side": self.truncation_side,
+            }, f, indent=2)
+        with open(os.path.join(directory, "special_tokens_map.json"), "w") as f:
+            json.dump({"pad_token": "<pad>", "bos_token": self.bos_token,
+                       "eos_token": self.eos_token}, f, indent=2)
+
 
 class HFTokenizer(BaseTokenizer):
     """Adapter over a transformers tokenizer (reference behavior:
@@ -227,6 +262,9 @@ class HFTokenizer(BaseTokenizer):
     def decode(self, ids, skip_special_tokens: bool = True) -> str:
         ids = np.asarray(ids).reshape(-1).tolist()
         return self.tk.decode(ids, skip_special_tokens=skip_special_tokens)
+
+    def save_pretrained(self, directory: str):
+        self.tk.save_pretrained(directory)
 
 
 def get_tokenizer(config) -> BaseTokenizer:

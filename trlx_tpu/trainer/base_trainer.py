@@ -956,6 +956,15 @@ class TPUTrainer(BaseRLTrainer):
 
             with open(os.path.join(directory, "config.json"), "w") as f:
                 json.dump(config_to_hf(self.model_cfg), f, indent=2)
+            # tokenizer files too, when the tokenizer can express itself in
+            # HF format (reference exports carry the tokenizer alongside,
+            # accelerate_base_trainer.py:284-307) — the dir then loads in
+            # plain transformers with AutoModel + AutoTokenizer
+            if hasattr(self.tokenizer, "save_pretrained"):
+                try:
+                    self.tokenizer.save_pretrained(directory)
+                except Exception as te:
+                    logger.warning(f"Tokenizer export skipped: {te}")
         except Exception as e:  # model family without HF layout — save msgpack
             logger.warning(f"HF export unavailable ({e}); saving flax msgpack instead")
             from flax import serialization

@@ -453,11 +453,16 @@ def test_pipelined_sft_trainer_1f1b_sequence(tmp_path):
     _flat_close(g1, g0)
 
 
-def test_ppo_refuses_1f1b_sequence():
-    """PPO's 1F1B loss windows per-sample response slices, which cross
-    sequence shards — PP x SP x 1f1b must fail loudly for it."""
+def test_pipelined_ppo_trainer_1f1b_sequence(tmp_path):
+    """PipelinedPPOTrainer on pipe=2 x sequence=2 under the 1F1B schedule
+    (r4: the full-token-width loss decomposition — response windows
+    preshift to their predicting positions in prepare(), so no shard reads
+    a neighbor's window): full PPO cycle end-to-end plus grad AND stats
+    parity against the batch-level ppo_loss. This is the deep-model
+    long-context RL layout the reference runs as TP x PP x DP + SP
+    (megatron_65b.yaml:49-50,:80)."""
+    import trlx_tpu as trlx
     from trlx_tpu.data.default_configs import default_ppo_config
-    from trlx_tpu.trainer.pipelined_ppo_trainer import PipelinedPPOTrainer
 
     config = default_ppo_config().evolve(
         model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
@@ -465,18 +470,88 @@ def test_ppo_refuses_1f1b_sequence():
         tokenizer=dict(tokenizer_path="byte"),
         train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
                    eval_interval=10, checkpoint_interval=100,
-                   trainer="PipelinedPPOTrainer", seed=3),
-        method=dict(num_rollouts=8, chunk_size=8,
-                    gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+                   trainer="PipelinedPPOTrainer",
+                   checkpoint_dir=str(tmp_path / "ppo1f1bsp"), seed=3),
+        method=dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=6, do_sample=True)),
         parallel=dict(data=2, fsdp=1, tensor=1, pipeline=2, sequence=2,
                       pipeline_schedule="1f1b"),
     )
-    # refused at CONSTRUCTION (like the other PP x SP constraints), so an
-    # incompatible config cannot burn a rollout phase first
-    with pytest.raises(NotImplementedError, match="sequence"):
-        PipelinedPPOTrainer(
-            config, reward_fn=lambda samples, **kw: [0.0] * len(samples)
+    trainer = trlx.train(
+        reward_fn=lambda samples, **kw: [float(len(s)) for s in samples],
+        prompts=["hello world", "jax tpu", "pipe line", "ppo test"] * 2,
+        config=config,
+    )
+    assert trainer.iter_count >= 2
+
+    batch = trainer.batch_to_device(
+        next(iter(trainer.store.create_loader(8, shuffle=False)))
+    )
+    grad_fn = jax.jit(trainer.make_grad_fn())
+    loss_fn = trainer.make_loss_fn()
+
+    def ref(train_params, frozen_params, batch):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_params, frozen_params, batch
         )
+        return loss, stats, grads
+
+    l1, s1, g1 = grad_fn(trainer.train_params, trainer.frozen_params, batch)
+    l0, s0, g0 = jax.jit(ref)(trainer.train_params, trainer.frozen_params, batch)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
+    _flat_close(s1, s0, rtol=2e-4, atol=1e-5)
+    _flat_close(g1, g0, rtol=2e-4, atol=1e-5)
+
+
+def test_pipelined_ilql_trainer_1f1b_sequence(tmp_path):
+    """PipelinedILQLTrainer on pipe=2 x sequence=2 under the 1F1B schedule
+    (r4: the full-width decomposition of ops/ilql.py — indices preshifted
+    to action positions, heads at every position, V all-gathered over the
+    sequence axis for the cross-shard state pairings): offline RL
+    end-to-end plus grad AND stats parity against the batch-level
+    ilql_loss."""
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_ilql_config
+
+    config = default_ilql_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=10, checkpoint_interval=100,
+                   trainer="PipelinedILQLTrainer",
+                   checkpoint_dir=str(tmp_path / "ilql1f1bsp"), seed=5),
+        method=dict(steps_for_target_q_sync=1, alpha=1.0,
+                    gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0,
+                                    temperature=1.0)),
+        parallel=dict(data=2, fsdp=1, tensor=1, pipeline=2, sequence=2,
+                      pipeline_schedule="1f1b"),
+    )
+    samples = [("ask", " yes"), ("ask", " no"), ("q", " maybe"), ("q", " sure")] * 4
+    rewards = [1.0, -1.0, 0.5, 0.2] * 4
+    trainer = trlx.train(
+        samples=samples, rewards=rewards, eval_prompts=["ask", "q"],
+        config=config,
+    )
+    assert trainer.iter_count >= 2
+
+    batch = trainer.batch_to_device(
+        next(iter(trainer.store.create_loader(8, shuffle=False, drop_last=True)))
+    )
+    grad_fn = jax.jit(trainer.make_grad_fn())
+    loss_fn = trainer.make_loss_fn()
+
+    def ref(train_params, frozen_params, batch):
+        (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_params, frozen_params, batch
+        )
+        return loss, stats, grads
+
+    l1, s1, g1 = grad_fn(trainer.train_params, trainer.frozen_params, batch)
+    l0, s0, g0 = jax.jit(ref)(trainer.train_params, trainer.frozen_params, batch)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-4)
+    _flat_close(s1, s0, rtol=2e-4, atol=1e-5)
+    _flat_close(g1, g0, rtol=2e-4, atol=1e-5)
 
 
 def test_interleave_refuses_1f1b():

@@ -90,6 +90,70 @@ def ilql_loss_terms(
     return terms, aux
 
 
+def ilql_fullwidth_terms(
+    logits: jnp.ndarray,  # [b, tl, V] (tl = sequence-shard-local width)
+    qs_all: Sequence[jnp.ndarray],  # each [b, tl, V] — Q heads at ALL positions
+    target_qs_all: Sequence[jnp.ndarray],  # each [b, tl, V]
+    v_global: jnp.ndarray,  # [b, t] — V head outputs all-gathered over sequence
+    labels: jnp.ndarray,  # [b, tl] preshifted tokens: labels[p] = token[p+1]
+    tmask: jnp.ndarray,  # [b, tl] 1.0 at valid (nonterminal) ACTION positions
+    rewards: jnp.ndarray,  # [b, tl] reward of the action at p (0 elsewhere)
+    state_pos: jnp.ndarray,  # [b, tl] GLOBAL index of the action's state
+    next_pos: jnp.ndarray,  # [b, tl] GLOBAL index of the action's next state
+    next_done: jnp.ndarray,  # [b, tl] dones[h+1] scattered to p
+    tau: float,
+    gamma: float,
+    beta: float = 0.0,
+) -> Tuple[Dict, Dict]:
+    """Sequence-parallel decomposition of `ilql_loss_terms`: every tensor
+    is FULL-TOKEN-WIDTH, anchored at the action's predicting position p
+    (the CE preshift), so the only cross-shard dependency is V at the
+    state/next-state positions — which arrives pre-gathered as `v_global`
+    ([b, t] scalars, the one small collective this loss needs). For every
+    valid action h at p = actions_ixs[h] the terms are identical to
+    ilql_loss_terms' (same gathers expressed in position space); invalid
+    slots carry tmask 0. Sums are bit-comparable up to reassociation."""
+    Qa = [
+        jnp.take_along_axis(q, labels[..., None], axis=-1)[..., 0] for q in qs_all
+    ]
+    tQa = [
+        jax.lax.stop_gradient(
+            jnp.take_along_axis(q, labels[..., None], axis=-1)[..., 0]
+        )
+        for q in target_qs_all
+    ]
+    targetQ = tQa[0]
+    for tq in tQa[1:]:
+        targetQ = jnp.minimum(targetQ, tq)
+
+    V = jnp.take_along_axis(v_global, state_pos, axis=1)  # grads flow (expectile)
+    Vnext = jax.lax.stop_gradient(
+        jnp.take_along_axis(v_global, next_pos, axis=1)
+    ) * next_done
+    Q_target = rewards + gamma * Vnext
+
+    q_sum = sum((((Qi - Q_target) ** 2) * tmask).sum() for Qi in Qa)
+
+    diff = targetQ - V
+    v_sum = ((jnp.where(diff >= 0, tau, 1 - tau) * diff**2) * tmask).sum()
+
+    def cql_sum_fn(q):
+        logprobs = jax.nn.log_softmax(q.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logprobs, labels[..., None], axis=-1)[..., 0]
+        return (nll * tmask).sum()
+
+    cql_sum = sum(cql_sum_fn(q) for q in qs_all)
+
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    cross_entropy = -jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+    awac_weight = jax.lax.stop_gradient(jnp.exp(beta * (targetQ - V)))
+    awac_sum = (cross_entropy * awac_weight * tmask).sum()
+
+    terms = dict(q_sum=q_sum, v_sum=v_sum, cql_sum=cql_sum, awac_sum=awac_sum)
+    aux = dict(V=V, Q=Qa, terminal_mask=tmask)
+    return terms, aux
+
+
 def ilql_loss(
     logits: jnp.ndarray,  # [b, t, V] over full sequence
     qs: Sequence[jnp.ndarray],  # each [b, n_actions, V]

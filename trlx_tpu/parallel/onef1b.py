@@ -113,15 +113,22 @@ def gated_reducers(gate):
     return gsum, gmin, gmax
 
 
-def finalize_tensor_stats(d, n, gsum, gmin, gmax):
+def finalize_tensor_stats(d, n, gsum, gmin, gmax, count=None):
     """get_tensor_stats from banked masked_sums; std uses the
-    algebraically-equal sqrt(E[x^2] - mean^2) form."""
+    algebraically-equal sqrt(E[x^2] - mean^2) form. When the global masked
+    `count` is supplied and zero, min/max clamp to 0 (matching the batch
+    path utils/modeling.py get_tensor_stats) instead of the +/-inf the
+    empty-gated reductions would produce."""
     mean = gsum(d["s"]) / n
     e2 = gsum(d["s2"]) / n
+    mn, mx = gmin(d["min"]), gmax(d["max"])
+    if count is not None:
+        mn = jnp.where(count > 0, mn, 0.0)
+        mx = jnp.where(count > 0, mx, 0.0)
     return dict(
         mean=mean,
-        min=gmin(d["min"]),
-        max=gmax(d["max"]),
+        min=mn,
+        max=mx,
         std=jnp.sqrt(jnp.maximum(e2 - mean * mean, 0.0)),
     )
 
@@ -146,6 +153,10 @@ def make_1f1b_grad_fn(
     ctx_fn: Optional[Callable] = None,  # (tokens, attn_mask, batch) -> ctx; runs INSIDE shard_map
     finalize_fn: Callable = default_finalize,  # (tick_stats[n_ticks], gate[n_ticks], ctx) -> stats
     freeze_split: int = 0,
+    loss_collectives: bool = False,  # loss_mb contains collectives (e.g. the
+    # ILQL SP path's sequence all_gather of V) — forces the predicated
+    # always-compute loss slot, since a collective may not sit under the
+    # lax.cond fast path (its predicate is pipe-varying)
 ) -> Callable:
     """Build fn(stacked, rest, heads, tokens, attn_mask, batch) ->
     (loss, stats, (d_stacked, d_rest, d_heads)).
@@ -188,8 +199,9 @@ def make_1f1b_grad_fn(
     # (TP/FSDP inside the pipe program) the branches would contain
     # GSPMD-inserted collectives under a device-varying predicate, so
     # there we keep the predicated always-compute form.
-    full_manual = all(
-        mesh_shape.get(ax, 1) == 1 for ax in ("fsdp", "tensor")
+    full_manual = (
+        all(mesh_shape.get(ax, 1) == 1 for ax in ("fsdp", "tensor"))
+        and not loss_collectives
     )
 
     def embed_apply(rest, tok, pos):

@@ -33,8 +33,73 @@ from trlx_tpu.utils import logging
 logger = logging.get_logger(__name__)
 
 
+def _ilql_1f1b_contrib_stats(cfg, terms, aux, n):
+    """Shared tail of both 1F1B loss decompositions (gather-based and
+    full-width SP): combine the sum-form terms into the microbatch's loss
+    contribution and bank the per-microbatch stat accumulators."""
+    from trlx_tpu.parallel.onef1b import masked_sums
+
+    contrib = (
+        terms["q_sum"] + terms["v_sum"]
+        + cfg.cql_scale * terms["cql_sum"]
+        + cfg.awac_scale * terms["awac_sum"]
+    ) / n
+    tm = aux["terminal_mask"]
+    stats = dict(
+        **terms,
+        values=masked_sums(aux["V"], tm),
+        qvalues={
+            str(ix): masked_sums(aux["Q"][ix], tm)
+            for ix in range(len(aux["Q"]))
+        },
+    )
+    return contrib, jax.lax.stop_gradient(stats)
+
+
+def _make_ilql_1f1b_finalize(cfg):
+    """ONE finalize_fn for both 1F1B decompositions — a stat change here
+    cannot desynchronize the SP and non-SP paths."""
+    from trlx_tpu.parallel.onef1b import finalize_tensor_stats, gated_reducers
+
+    def finalize_fn(ts, gate, ctx):
+        n = ctx["n"]
+        gsum, gmin, gmax = gated_reducers(gate)
+        loss_q = gsum(ts["q_sum"]) / n
+        loss_v = gsum(ts["v_sum"]) / n
+        loss_cql = gsum(ts["cql_sum"]) / n
+        loss_awac = gsum(ts["awac_sum"]) / n
+        loss = (
+            loss_q + loss_v + cfg.cql_scale * loss_cql
+            + cfg.awac_scale * loss_awac
+        )
+        return dict(
+            losses=dict(
+                loss=loss, loss_q=loss_q, loss_v=loss_v,
+                loss_cql=loss_cql, loss_awac=loss_awac,
+            ),
+            values=finalize_tensor_stats(ts["values"], n, gsum, gmin, gmax,
+                                         count=ctx.get("count")),
+            qvalues={
+                k: finalize_tensor_stats(d, n, gsum, gmin, gmax,
+                                         count=ctx.get("count"))
+                for k, d in ts["qvalues"].items()
+            },
+        )
+
+    return finalize_fn
+
+
 @register_trainer
 class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
+    # r4: under SP the 1F1B loss switches to the full-token-width
+    # decomposition (ops/ilql.py ilql_fullwidth_terms): indices preshift to
+    # action positions on the host, heads run at every position, and the
+    # single cross-shard dependency — V at state/next-state positions — is
+    # one tiny [B, t] all_gather over the sequence axis. Without SP the
+    # original gather-based decomposition stays (heads only run on action
+    # positions there, which is cheaper).
+    _1f1b_supports_sequence = True
+
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)
         self._n_microbatches = n_microbatches
@@ -86,6 +151,10 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
     # ------------------------------------------------------------------
 
     def make_1f1b_loss_parts(self, model):
+        mesh = self.runtime.mesh
+        seq_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sequence", 1)
+        if seq_ways > 1:
+            return self._make_1f1b_loss_parts_sp(model)
         cfg = self.ilql
         heads_mod = ILQLHeads(
             self.model_cfg.vocab_size, cfg.two_qs,
@@ -93,11 +162,6 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
         )
 
         from trlx_tpu.ops.ilql import ilql_loss_terms
-        from trlx_tpu.parallel.onef1b import (
-            finalize_tensor_stats,
-            gated_reducers,
-            masked_sums,
-        )
 
         def prepare(batch: ILQLBatch):
             loss_batch = dict(
@@ -110,13 +174,11 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
 
         def ctx_fn(tokens, attn_mask, batch):
             n_local = batch["dones"][:, :-1].astype(jnp.float32).sum()
-            # ("data", "sequence"): sequence is size 1 (SP refuses ILQL x
-            # 1f1b) but still manual — see pipelined_ppo_trainer.ctx_fn
-            return {
-                "n": jnp.maximum(
-                    jax.lax.psum(n_local, ("data", "sequence")), 1.0
-                )
-            }
+            # reduced over ("data", "sequence"): sequence is size 1 on this
+            # path (SP uses the full-width parts below) but still manual —
+            # see pipelined_ppo_trainer.ctx_fn
+            count = jax.lax.psum(n_local, ("data", "sequence"))
+            return {"n": jnp.maximum(count, 1.0), "count": count}
 
         def loss_mb(rest, heads, h, tok, mask, mb, ctx):
             logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
@@ -129,49 +191,91 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
                 tok, mb["actions_ixs"], mb["dones"], mb["rewards"],
                 tau=cfg.tau, gamma=cfg.gamma, beta=cfg.beta,
             )
-            n = ctx["n"]
-            contrib = (
-                terms["q_sum"] + terms["v_sum"]
-                + cfg.cql_scale * terms["cql_sum"]
-                + cfg.awac_scale * terms["awac_sum"]
-            ) / n
-            tm = aux["terminal_mask"]
-            stats = dict(
-                **terms,
-                values=masked_sums(aux["V"], tm),
-                qvalues={
-                    str(ix): masked_sums(aux["Q"][ix], tm)
-                    for ix in range(len(aux["Q"]))
-                },
-            )
-            return contrib, jax.lax.stop_gradient(stats)
-
-        def finalize_fn(ts, gate, ctx):
-            n = ctx["n"]
-            gsum, gmin, gmax = gated_reducers(gate)
-            loss_q = gsum(ts["q_sum"]) / n
-            loss_v = gsum(ts["v_sum"]) / n
-            loss_cql = gsum(ts["cql_sum"]) / n
-            loss_awac = gsum(ts["awac_sum"]) / n
-            loss = (
-                loss_q + loss_v + cfg.cql_scale * loss_cql
-                + cfg.awac_scale * loss_awac
-            )
-            return dict(
-                losses=dict(
-                    loss=loss, loss_q=loss_q, loss_v=loss_v,
-                    loss_cql=loss_cql, loss_awac=loss_awac,
-                ),
-                values=finalize_tensor_stats(ts["values"], n, gsum, gmin, gmax),
-                qvalues={
-                    k: finalize_tensor_stats(d, n, gsum, gmin, gmax)
-                    for k, d in ts["qvalues"].items()
-                },
-            )
+            return _ilql_1f1b_contrib_stats(cfg, terms, aux, ctx["n"])
 
         return {
             "prepare": prepare,
             "ctx_fn": ctx_fn,
             "loss_mb": loss_mb,
-            "finalize_fn": finalize_fn,
+            "finalize_fn": _make_ilql_1f1b_finalize(cfg),
+        }
+
+    # ------------------------------------------------------------------
+    # 1F1B x SP loss: full-token-width decomposition. The gather-based
+    # parts above window h/logits by per-sample index arrays, which cross
+    # sequence shards; here every tensor preshifts to the action's
+    # predicting position p on the host side (prepare), the heads run at
+    # every local position, and the one live cross-shard dependency — V at
+    # state/next-state positions — is a single [B, t] all_gather over the
+    # sequence axis inside the loss (scalars; ~KB-scale). Sums equal the
+    # gather-based path's up to float reassociation.
+    # ------------------------------------------------------------------
+
+    def _make_1f1b_loss_parts_sp(self, model):
+        cfg = self.ilql
+        heads_mod = ILQLHeads(
+            self.model_cfg.vocab_size, cfg.two_qs,
+            self.model_cfg.dtype, self.model_cfg.param_dtype,
+        )
+
+        from trlx_tpu.ops.ilql import ilql_fullwidth_terms
+
+        def prepare(batch: ILQLBatch):
+            tokens = batch.input_ids
+            attn = batch.attention_mask
+            B, t = tokens.shape
+            tmask_a = batch.dones[:, :-1].astype(jnp.float32)  # [B, A]
+            rows = jnp.arange(B)[:, None]
+            # valid action positions are <= t-2 (the action token must
+            # exist at p+1), so t-1 is a safe trash slot for padded action
+            # entries; anything written there carries tmask 0 and is
+            # masked out of every term
+            trash = t - 1
+            p = jnp.where(tmask_a > 0, batch.actions_ixs, trash).astype(jnp.int32)
+
+            def scatter(vals, dtype=jnp.float32):
+                return jnp.zeros((B, t), dtype).at[rows, p].set(
+                    vals.astype(dtype)
+                )
+
+            loss_batch = dict(
+                labels=jnp.pad(tokens[:, 1:], ((0, 0), (0, 1))),
+                tmask=scatter(tmask_a),
+                rewards=scatter(batch.rewards),
+                state_pos=scatter(batch.states_ixs[:, :-1], jnp.int32),
+                next_pos=scatter(batch.states_ixs[:, 1:], jnp.int32),
+                next_done=scatter(batch.dones[:, 1:]),
+            )
+            return tokens, attn, loss_batch
+
+        def ctx_fn(tokens, attn_mask, batch):
+            count = jax.lax.psum(batch["tmask"].sum(), ("data", "sequence"))
+            return {"n": jnp.maximum(count, 1.0), "count": count}
+
+        def loss_mb(rest, heads, h, tok, mask, mb, ctx):
+            logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
+            qs_all, tqs_all, vs_all = heads_mod.apply(
+                {"params": heads["ilql_heads"]}, h_final
+            )
+            v_global = jax.lax.all_gather(
+                vs_all[..., 0].astype(jnp.float32), "sequence", axis=1, tiled=True
+            )
+            terms, aux = ilql_fullwidth_terms(
+                logits, qs_all, tqs_all, v_global,
+                mb["labels"], mb["tmask"], mb["rewards"],
+                mb["state_pos"], mb["next_pos"], mb["next_done"],
+                tau=cfg.tau, gamma=cfg.gamma, beta=cfg.beta,
+            )
+            return _ilql_1f1b_contrib_stats(cfg, terms, aux, ctx["n"])
+
+        return {
+            "prepare": prepare,
+            "ctx_fn": ctx_fn,
+            "loss_mb": loss_mb,
+            "finalize_fn": _make_ilql_1f1b_finalize(cfg),
+            "seq_aligned": {
+                "labels", "tmask", "rewards", "state_pos", "next_pos",
+                "next_done",
+            },
+            "loss_collectives": True,
         }

@@ -84,6 +84,11 @@ def causal_ce_1f1b_parts(model) -> Dict:
         "ctx_fn": ctx_fn,
         "loss_mb": loss_mb,
         "wrap_stats": lambda loss, stats: {"loss": loss},
+        # loss_batch keys whose dim 1 is token-aligned and must receive the
+        # SP divisibility padding (explicit, never inferred from shape:
+        # a [B, L] leaf with L == t by coincidence must NOT be zero-padded
+        # and sequence-sharded)
+        "seq_aligned": {"ce_labels", "ce_valid"},
     }
 
 
@@ -97,11 +102,14 @@ class PipelinedCausalMixin:
     # their left-padded collation.
     _sp_needs_right_padding = False
     # Whether this trainer's 1F1B loss decomposition composes with
-    # sequence parallelism. CE trainers preshift targets globally so a
-    # shard never reads its neighbor's labels; PPO/ILQL window/gather
-    # per-sample slices that cross sequence shards. Checked at
-    # CONSTRUCTION (like the other PP x SP constraints) so an
-    # incompatible config fails before any rollout work.
+    # sequence parallelism. All four method trainers now do (r4): CE
+    # trainers preshift targets globally so a shard never reads its
+    # neighbor's labels; PPO re-expresses its response windows in full
+    # token width the same way; ILQL switches to the full-width
+    # decomposition with a [B, t] V all_gather for cross-shard state
+    # pairings. The flag stays as the extension point for future method
+    # trainers whose losses have not been decomposed yet; construction
+    # refuses incompatible configs before any rollout work.
     _1f1b_supports_sequence = False
 
     def _validate_pipeline_config(self, config: TRLConfig) -> TRLConfig:
@@ -384,15 +392,26 @@ class PipelinedCausalMixin:
         seq_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("sequence", 1)
         # _validate_pipeline_config already refused incompatible configs at
         # construction; this is the defensive backstop for direct callers
-        assert seq_ways == 1 or self._1f1b_supports_sequence
+        # (a real raise, not an assert — `python -O` must not strip it)
+        if seq_ways > 1 and not self._1f1b_supports_sequence:
+            raise NotImplementedError(
+                f"{type(self).__name__}'s 1F1B loss does not compose with "
+                "sequence parallelism; use pipeline_schedule='gpipe'"
+            )
         engine = make_1f1b_grad_fn(
             model, self.model_cfg, mesh, self._n_microbatches,
             parts["loss_mb"], ctx_fn=parts.get("ctx_fn"),
             finalize_fn=parts.get("finalize_fn", default_finalize),
             freeze_split=self._freeze_split(),
+            loss_collectives=parts.get("loss_collectives", False),
         )
         prepare = parts["prepare"]
         wrap_stats = parts.get("wrap_stats", lambda loss, stats: stats)
+        # loss_batch keys that are token-aligned on dim 1 come from an
+        # EXPLICIT declaration by the method's loss parts — never inferred
+        # from shape equality (a [B, L] leaf with L == t by coincidence
+        # must not be zero-padded and sequence-sharded)
+        seq_aligned = parts.get("seq_aligned", frozenset())
 
         def grad_fn(train_params, frozen_params, batch):
             params = merge_params(train_params, frozen_params)
@@ -404,12 +423,16 @@ class PipelinedCausalMixin:
             t0 = tokens.shape[1]
             rem = (-t0) % seq_ways
             if rem:
+                missing = set(seq_aligned) - set(loss_batch)
+                if missing:
+                    raise KeyError(
+                        f"seq_aligned declares keys absent from loss_batch: {missing}"
+                    )
                 tokens, attn_mask = _pad_seq(tokens, rem), _pad_seq(attn_mask, rem)
-                loss_batch = jax.tree_util.tree_map(
-                    lambda x: _pad_seq(x, rem)
-                    if x.ndim >= 2 and x.shape[1] == t0 else x,
-                    loss_batch,
-                )
+                loss_batch = {
+                    k: _pad_seq(v, rem) if k in seq_aligned else v
+                    for k, v in loss_batch.items()
+                }
             loss, stats, (d_stacked, d_rest, d_heads) = engine(
                 params["lm_stacked"], params["lm_rest"], heads,
                 tokens, attn_mask, loss_batch,

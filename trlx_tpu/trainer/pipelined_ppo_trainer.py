@@ -57,6 +57,13 @@ logger = logging.get_logger(__name__)
 
 @register_trainer
 class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
+    # r4: the 1F1B loss is expressed in full token width (prepare() scatters
+    # the response windows to their predicting positions, CE-preshift
+    # style), so it composes with sequence parallelism — the deep-model
+    # long-context RL layout (reference megatron_65b.yaml:49-50,:80) no
+    # longer falls back to GPipe's [B, t, V] logits bank.
+    _1f1b_supports_sequence = True
+
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)
         if getattr(config.method, "num_value_layers_unfrozen", 0):
@@ -144,8 +151,6 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
         method = self.config.method
         pad_id = self.tokenizer.pad_token_id
         v_head = self._head_module()
-        mesh = self.runtime.mesh
-        data_ways = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
 
         from trlx_tpu.parallel.onef1b import (
             finalize_tensor_stats,
@@ -154,6 +159,13 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
         )
 
         def prepare(batch: PPORLBatch):
+            """Re-express the response-window PPO loss in FULL token width:
+            every per-position tensor (old logprobs/values, advantages,
+            returns, masks) is placed at its PREDICTING position p (the
+            logit at p scores token p+1 — the same global preshift the CE
+            trainers use), so the in-pipe loss is purely elementwise and a
+            sequence shard never reads a neighbor's window. The windows
+            live here, outside the shard_map, where they are free."""
             tokens = jnp.concatenate(
                 [batch.query_tensors, batch.response_tensors], axis=1
             )
@@ -161,38 +173,47 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             advantages, returns = get_advantages_and_returns(
                 batch.values, batch.rewards, method.gamma, method.lam
             )
+            B, t = tokens.shape
+            q = batch.query_tensors.shape[1]
+            r = batch.response_tensors.shape[1]
+            start = q - 1  # predicting positions for the response: start..t-2
+
+            def widen(x):
+                full = jnp.zeros((B, t), jnp.float32)
+                return jax.lax.dynamic_update_slice(
+                    full, x.astype(jnp.float32), (0, start)
+                )
+
+            m_full = widen(attn[:, start + 1 : start + r + 1])
+            win_full = widen(jnp.ones((B, r), jnp.float32))
             loss_batch = dict(
-                query=batch.query_tensors,
-                old_logprobs=batch.logprobs,
-                old_values=batch.values,
-                advantages=advantages,
-                returns=returns,
+                # CE-style preshifted labels: label[p] = token[p+1]
+                labels=jnp.pad(tokens[:, 1:], ((0, 0), (0, 1))),
+                mask=m_full,
+                window=win_full,
+                old_logprobs=widen(batch.logprobs),
+                old_values=widen(batch.values),
+                advantages=widen(advantages),
+                returns=widen(returns),
             )
             return tokens, attn, loss_batch
 
         def ctx_fn(tokens, attn_mask, batch):
-            start = batch["query"].shape[1] - 1
-            L = batch["old_logprobs"].shape[1]
-            m = attn_mask[:, start + 1 : start + L + 1]
-            # ("data", "sequence"): the sequence axis is size 1 here (SP
-            # refuses PPO x 1f1b) but still MANUAL, so n must be reduced
-            # over it or every stat divided by n stays sequence-varying
-            # and violates the replicated out_specs
-            n = jnp.maximum(
-                jax.lax.psum(m.sum(), ("data", "sequence")).astype(jnp.float32),
-                1.0,
-            )
-            return {"n": n, "size": float(tokens.shape[0] * data_ways * L)}
+            # reduced over ("data", "sequence"): under PP x SP each shard
+            # contributes its local masked count; without SP the sequence
+            # axis is size 1 but still manual, so the psum keeps n
+            # replicated as the out_specs require
+            count = jax.lax.psum(batch["mask"].sum(), ("data", "sequence"))
+            n = jnp.maximum(count, 1.0)
+            size = jax.lax.psum(batch["window"].sum(), ("data", "sequence"))
+            return {"n": n, "count": count, "size": size}
 
         def loss_mb(rest, heads, h, tok, mask, mb, ctx):
             logits, h_final = model.apply({"params": rest}, h, method=model.unembed)
             values = v_head.apply({"params": heads["v_head"]}, h_final)[..., 0]
-            lp_all = logprobs_of_labels(logits[:, :-1, :], tok[:, 1:])
-            start = mb["query"].shape[1] - 1
-            L = mb["old_logprobs"].shape[1]
-            lp = lp_all[:, start : start + L]
-            vp = values[:, :-1][:, start : start + L]
-            m = mask[:, start + 1 : start + L + 1].astype(jnp.float32)
+            lp = logprobs_of_labels(logits, mb["labels"])
+            vp = values
+            m = mb["mask"]
             old_lp, old_v = mb["old_logprobs"], mb["old_values"]
             adv, ret = mb["advantages"], mb["returns"]
             n = ctx["n"]
@@ -231,7 +252,8 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             gsum, gmin, gmax = gated_reducers(gate)
 
             def tensor_stats(d):
-                return finalize_tensor_stats(d, n, gsum, gmin, gmax)
+                return finalize_tensor_stats(d, n, gsum, gmin, gmax,
+                                             count=ctx.get("count"))
 
             pg_loss = gsum(ts["pg_sum"]) / n
             vf_loss = 0.5 * gsum(ts["vf_max_sum"]) / n
@@ -260,6 +282,12 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             "ctx_fn": ctx_fn,
             "loss_mb": loss_mb,
             "finalize_fn": finalize_fn,
+            # every loss_batch leaf is full token width by construction, so
+            # all of them take the SP divisibility padding
+            "seq_aligned": {
+                "labels", "mask", "window", "old_logprobs", "old_values",
+                "advantages", "returns",
+            },
         }
 
     # ------------------------------------------------------------------

@@ -81,11 +81,16 @@ def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
 
 
 def get_tensor_stats(xs: jnp.ndarray, mask: jnp.ndarray, n: jnp.ndarray) -> Dict:
-    """mean/min/max/std over masked entries (reference utils/modeling.py)."""
+    """mean/min/max/std over masked entries (reference utils/modeling.py).
+    An all-zero mask clamps min/max to 0 instead of +/-inf (mean/std are
+    already finite via the caller's n >= 1 clamp); the 1F1B stat path
+    (parallel/onef1b.py finalize_tensor_stats) applies the same clamp so
+    the two stat paths stay bit-compatible on this edge case."""
     mask = mask.astype(xs.dtype)
+    any_valid = mask.sum() > 0
     mean = (xs * mask).sum() / n
-    minimum = jnp.where(mask > 0, xs, jnp.inf).min()
-    maximum = jnp.where(mask > 0, xs, -jnp.inf).max()
+    minimum = jnp.where(any_valid, jnp.where(mask > 0, xs, jnp.inf).min(), 0.0)
+    maximum = jnp.where(any_valid, jnp.where(mask > 0, xs, -jnp.inf).max(), 0.0)
     std = jnp.sqrt((((xs - mean) * mask) ** 2).sum() / n)
     return dict(mean=mean, min=minimum, max=maximum, std=std)
 

@@ -110,12 +110,22 @@ def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False):
 
 
 def main():
+    import jax
+
+    try:  # persistent XLA compile cache (same dir as bench.py): the 8k/16k
+        # flash fwd+bwd graphs take minutes to compile cold, seconds warm
+        jax.config.update("jax_compilation_cache_dir", "/tmp/trlx_tpu_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     smoke = "--smoke" in sys.argv
     if smoke:
         run(512, 2, n_steps=2, smoke=True)
         return
     run(8192, 4)
-    run(16384, 2)
+    if "--8k-only" not in sys.argv:
+        run(16384, 2)
 
 
 if __name__ == "__main__":

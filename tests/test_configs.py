@@ -44,12 +44,19 @@ def test_preset_parallel_sections_name_real_trainers():
 
 
 def test_sweep_yamls_drive_sampler():
+    from trlx_tpu.sweep import make_searcher
+
     for path in SWEEPS:
         with open(path) as f:
             config = yaml.safe_load(f)
         tune = config.pop("tune_config")
-        trials = sample_trials(config, tune.get("search_alg", "random"),
-                               num_samples=3, seed=0)
+        alg = tune.get("search_alg", "random")
+        if alg in ("random", "grid", "grid_search"):
+            trials = sample_trials(config, alg, num_samples=3, seed=0)
+        else:
+            # model-based algs (tpe) propose through the searcher interface
+            searcher = make_searcher(config, alg, num_samples=3, seed=0)
+            trials = [searcher.suggest() for _ in range(3)]
         assert len(trials) == 3
         assert all(set(t) == set(config) for t in trials), path
 

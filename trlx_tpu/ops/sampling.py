@@ -53,6 +53,9 @@ class GenerationConfig:
     length_penalty: float = 1.0
     # ILQL advantage shift (reference gen_kwargs beta, default_configs.py:92)
     beta: float = 1.0
+    # HF SuppressTokensLogitsProcessor (GenerationConfig.suppress_tokens):
+    # these ids get -inf at every decode step
+    suppress_tokens: tuple = ()
 
     @classmethod
     def from_gen_kwargs(cls, gen_kwargs: Dict, eos_token_id: int, pad_token_id: int):
@@ -69,6 +72,7 @@ class GenerationConfig:
             num_beams=int(kw.get("num_beams", 1) or 1),
             length_penalty=float(kw.get("length_penalty", 1.0) or 1.0),
             beta=float(kw.get("beta", 1.0)),
+            suppress_tokens=tuple(kw.get("suppress_tokens") or ()),
             eos_token_id=eos_token_id,
             pad_token_id=pad_token_id,
         )
@@ -131,13 +135,20 @@ def make_generate_fn(
     path via HF, plus ILQL seq2seq generation modeling_ilql.py:481-667)."""
     max_new = gen_cfg.max_new_tokens
     forbid = jnp.asarray(logit_mask) if logit_mask is not None else None
+    suppress = None
+    if gen_cfg.suppress_tokens:
+        # [V] additive mask, built once here so the id list (possibly tens
+        # of thousands of entries) constant-folds instead of re-tracing
+        m = np.zeros((model_cfg.vocab_size,), np.float32)
+        m[np.asarray(gen_cfg.suppress_tokens, np.int64)] = -np.inf
+        suppress = jnp.asarray(m)
     is_seq2seq = bool(getattr(model_cfg, "is_seq2seq", False))
 
     if gen_cfg.num_beams > 1:
-        if mode != "lm" or logit_mask is not None:
+        if mode != "lm" or logit_mask is not None or gen_cfg.suppress_tokens:
             raise NotImplementedError(
                 "num_beams > 1 supports plain LM generation only (no ILQL "
-                "advantage shift or transition logit masks)"
+                "advantage shift, transition logit masks, or suppress_tokens)"
             )
         if gen_cfg.repetition_penalty != 1.0:
             raise NotImplementedError(
@@ -180,6 +191,8 @@ def make_generate_fn(
 
     def shift_logits(logits, adv, prev_token):
         """Mode-specific logit rewrite before sampling."""
+        if suppress is not None:
+            logits = logits + suppress
         if forbid is not None:
             # forbid transitions from the previous token (reference
             # modeling_ilql.py:378-380)

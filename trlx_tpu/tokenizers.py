@@ -108,6 +108,42 @@ class BaseTokenizer:
             "attention_mask": [[1] * len(s) for s in seqs],
         }
 
+    def device_retokenize(self, response_ids, max_new: int):
+        """In-graph (jnp) equivalent of the host decode->encode round trip
+        the PPO experience stage performs on generated responses
+        (base_trainer.decode with append_eos_token=True, then
+        encode()[:max_new], right-padded): drop every id that decodes to
+        nothing (ids >= _n_plain_ids: specials and vocab-padding ids),
+        compact the survivors left, restore the trailing eos iff
+        generation stopped early (last raw token is eos/pad). Lets the
+        rollout scorer run speculatively on device-resident samples while
+        the host computes rewards — the host result still arbitrates
+        (trlx_tpu/trainer/ppo_trainer.py pipelined_cycle compares
+        element-for-element and falls back). Only defined for tokenizers
+        whose decode->encode round trip is id-local (byte/char); HF
+        tokenizers may merge or re-split, so they don't offer it. Not
+        valid with stop_sequences (those trim by string content)."""
+        n_plain = getattr(self, "_n_plain_ids", None)
+        if n_plain is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no in-graph retokenize"
+            )
+        import jax.numpy as jnp
+
+        ids = response_ids.astype(jnp.int32)
+        valid = ids < n_plain
+        # stable left-compaction of the surviving ids
+        order = jnp.argsort(~valid, axis=1, stable=True)
+        compact = jnp.take_along_axis(ids, order, axis=1)
+        n_valid = valid.sum(axis=1)
+        j = jnp.arange(max_new)[None, :]
+        out = jnp.where(j < n_valid[:, None], compact[:, :max_new], self.pad_token_id)
+        stopped_early = (ids[:, -1] == self.eos_token_id) | (
+            ids[:, -1] == self.pad_token_id
+        )
+        put_eos = stopped_early[:, None] & (j == n_valid[:, None]) & (j < max_new)
+        return jnp.where(put_eos, self.eos_token_id, out)
+
 
 class ByteTokenizer(BaseTokenizer):
     """UTF-8 byte-level tokenizer: ids 0..255 are bytes; 256=pad, 257=bos,
@@ -123,6 +159,8 @@ class ByteTokenizer(BaseTokenizer):
         self.eos_token = "<|eos|>"
         self.bos_token = "<|bos|>"
         self.name_or_path = "byte"
+        self._n_plain_ids = 256  # ids below this decode to text; everything
+        # else (specials, vocab-padding ids) decodes to nothing
 
     def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
         ids = self._encode_with_specials(text, lambda t: list(t.encode("utf-8")))
@@ -167,6 +205,7 @@ class CharTokenizer(BaseTokenizer):
         self.eos_token = "="  # single printable char so decoded evals read cleanly
         self.bos_token = "^"
         self.name_or_path = f"char:{alphabet}"
+        self._n_plain_ids = len(alphabet)
 
     def encode(self, text: str, add_eos: bool = False, add_special_tokens: bool = True) -> List[int]:
         ids = self._encode_with_specials(

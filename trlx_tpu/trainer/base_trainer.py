@@ -147,8 +147,13 @@ class TPUTrainer(BaseRLTrainer):
         # A single list-valued gen kwarg becomes an eval-time sweep
         # (reference generate_sweep_kwarg, accelerate_base_trainer.py:139-146):
         # evaluate() runs once per value and logs metrics with @k=v suffixes.
+        # Kwargs whose VALUE is inherently a list (HF GenerationConfig
+        # list-typed fields) are exempt from sweep detection.
+        LIST_TYPED = {"suppress_tokens", "begin_suppress_tokens", "bad_words_ids"}
         self.generate_sweep_kwarg = None
         for k, v in list(self.generate_kwargs.items()):
+            if k in LIST_TYPED:
+                continue
             if isinstance(v, list):
                 if self.generate_sweep_kwarg is not None:
                     logger.info(f"Only a single sweep is allowed, {k} is going to be set to {v[0]}")

@@ -57,3 +57,75 @@ def test_interleaved_backward_memory_bounded():
     small = _grad_temp_bytes(2, n_virtual=2)
     large = _grad_temp_bytes(8, n_virtual=2)
     assert large < small * 1.5, (small, large)
+
+
+def _param_bytes(tree):
+    return sum(
+        x.nbytes for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "nbytes")
+    )
+
+
+def test_decode_param_swap_single_layout_residency(tmp_path):
+    """parallel.decode_param_swap (VERDICT r3 weak 2): during rollout/eval
+    generation the stacked train layout is DONATED into the decode view,
+    so peak param residency is ~one layout, not stacked + view. Pins:
+    (a) after standard_params() the old stacked leaves are dead and total
+        live param bytes <= 1.25x one layout;
+    (b) generation runs on the view;
+    (c) the first stacked consumer (train_params property) rebuilds the
+        layout BIT-EXACTLY (stack/unstack are pure reshapes/reshards)."""
+    import numpy as np
+
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.pipelined_sft_trainer import PipelinedSFTTrainer
+
+    config = default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                   model_extra_configs=dict(dtype="float32")),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=100, checkpoint_interval=100,
+                   checkpoint_dir=str(tmp_path)),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
+        parallel=dict(data=4, pipeline=2, decode_param_swap=True),
+    )
+    trainer = PipelinedSFTTrainer(config)
+
+    old_train = dict(trainer.train_params)
+    old_frozen = dict(trainer.frozen_params)
+    layout_bytes = _param_bytes(old_train) + _param_bytes(old_frozen)
+    before = {k: np.asarray(v) for k, v in old_train.items()}
+
+    view = trainer.standard_params()
+    assert trainer._decode_view_active
+    assert trainer._train_params_store is None
+
+    # (a) the donated stacked leaves are dead; live bytes ~ one layout
+    live_old = sum(
+        x.nbytes
+        for x in list(old_train.values()) + list(old_frozen.values())
+        if not x.is_deleted()
+    )
+    live = live_old + _param_bytes(view)
+    assert live <= 1.25 * layout_bytes, (live, layout_bytes, live_old)
+
+    # (b) generation runs on the view
+    prompts = np.full((4, 8), 104, np.int32)
+    out = trainer.generate(prompts, np.ones_like(prompts))
+    assert np.asarray(out["samples"]).shape == (4, 12)
+
+    # (c) transparent restack, bit-exact
+    restacked = trainer.train_params
+    assert not trainer._decode_view_active
+    for k, v in before.items():
+        np.testing.assert_array_equal(np.asarray(restacked[k]), v)
+
+    # and a train step runs afterwards on the rebuilt layout
+    trainer.make_experience(["swap roundtrip sample"] * 8, 32)
+    loader = trainer.store.create_loader(8, shuffle=False)
+    from trlx_tpu.pipeline import MiniBatchIterator
+
+    for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+        stats = trainer.train_minibatch(minibatch)
+        break
+    assert np.isfinite(float(np.asarray(stats["loss"])))

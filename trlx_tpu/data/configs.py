@@ -151,6 +151,12 @@ class ParallelConfig:
     # multi-slice scale-out: number of DCN-connected slices, folded into the
     # data axis so only data-parallel gradient reductions cross DCN
     dcn_data: int = 1
+    # pipelined trainers only: during rollout/eval generation, DONATE the
+    # stacked train layout into the decode-mesh view and rebuild it before
+    # the next train step, so peak param residency stays ~one layout
+    # instead of two (stacked + decode view). Costs two reshard programs
+    # per generate phase — enable when the model doesn't fit twice.
+    decode_param_swap: bool = False
     remat: bool = False
     scan_layers: bool = False
     param_dtype: str = "float32"

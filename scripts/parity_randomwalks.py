@@ -429,6 +429,19 @@ def cmd_compare(args):
             "ilql": "reference examples/randomwalks/ilql_randomwalks.py hparams, "
                     f"epochs={ILQL_EPOCHS}, eval_interval={ILQL_EVAL_INTERVAL}, beta=[1]",
         },
+        "notes": [
+            "Both sides load the same LM checkpoint; value/Q heads are "
+            "freshly initialized by each framework (as in the reference's "
+            "own from_pretrained flow), so ILQL's eval-0 points differ: "
+            "Q-guided decoding at beta=1 perturbs logits by the UNTRAINED "
+            "Q heads, whose init scale differs between frameworks. "
+            "Trained behavior (the curves past the first evals) is the "
+            "parity claim.",
+            "Reference PPO degrading from its warm start under its own "
+            "example hparams (init_kl_coef=0, lr 3e-4) is reproducible "
+            "across runs; same task instance, same checkpoint, same "
+            "reward probe as our run.",
+        ],
         "methods": {},
     }
     ok = True

@@ -600,6 +600,54 @@ class PPOTrainer(TPUTrainer):
         split = self.split
         pad_id = self.tokenizer.pad_token_id
 
+        if self.seq2seq:
+            # decoder-relative windows (start 0); response carries the
+            # decoder start token at position 0, so the valid-response
+            # count looks at positions 1: (mirrors _chunk_to_elements'
+            # n_resp = max(len(outputs[ix]), 1))
+            def score_reward_s2s(train_params, frozen_params, ref_params,
+                                 prompt_tensors, sample_outputs, scores_eff,
+                                 kl_coef):
+                params = merge_params(train_params, frozen_params)
+                attention_mask = (prompt_tensors != pad_id).astype(jnp.int32)
+                decoder_attention_mask = (sample_outputs != pad_id).astype(jnp.int32)
+                decoder_attention_mask = decoder_attention_mask.at[:, 0].set(1)
+                logits, values, ref_logits = forward_seq2seq_policy_and_ref(
+                    model, params, ref_params,
+                    prompt_tensors, attention_mask, sample_outputs,
+                    decoder_attention_mask, split,
+                )
+                logprobs = logprobs_of_labels(logits[:, :-1, :], sample_outputs[:, 1:])
+                ref_logprobs = logprobs_of_labels(
+                    ref_logits[:, :-1, :], sample_outputs[:, 1:]
+                )
+                log_ratio = (logprobs - ref_logprobs) * decoder_attention_mask[:, 1:]
+                kl = jnp.exp(log_ratio) - 1 - log_ratio
+                mean_kl = kl.sum(1).mean()
+                mean_kl_per_token = kl.mean()
+
+                r = sample_outputs.shape[1] - 1
+                j = jnp.arange(r)[None, :]
+                n_resp = jnp.maximum(
+                    (sample_outputs[:, 1:] != pad_id).sum(axis=1), 1
+                )[:, None]
+                valid = (j < n_resp).astype(jnp.float32)
+                rewards = (-kl_coef) * log_ratio * valid
+                if scalar_scores:
+                    rewards = rewards + (j == n_resp - 1) * scores_eff[:, :1]
+                else:
+                    rewards = rewards + scores_eff[:, :r] * valid
+                chunk = PPORLBatch(
+                    query_tensors=prompt_tensors,
+                    response_tensors=sample_outputs,
+                    logprobs=logprobs * valid,
+                    values=values[:, :-1] * valid,
+                    rewards=rewards,
+                )
+                return chunk, mean_kl, mean_kl_per_token
+
+            return jax.jit(score_reward_s2s)
+
         def score_reward(train_params, frozen_params, ref_params,
                          prompt_tensors, sample_outputs, scores_eff, kl_coef):
             params = merge_params(train_params, frozen_params)
@@ -812,9 +860,9 @@ class PPOTrainer(TPUTrainer):
         loss from pending[2][0] when done.
 
         Skips the rollout store / logging (use make_experience + learn for
-        those); causal models only."""
-        if self.seq2seq:
-            raise NotImplementedError("pipelined_cycle covers causal models")
+        those). seq2seq runs the cycle too (decoder-relative score+reward
+        fn) — just without the speculative scorer (the host retokenize is
+        not id-local there)."""
         method = self.config.method
         if method.num_rollouts % method.chunk_size != 0:
             raise NotImplementedError(

@@ -133,6 +133,22 @@ def finalize_tensor_stats(d, n, gsum, gmin, gmax, count=None):
     )
 
 
+def cond_or_zeros(pred, fn, args):
+    """`lax.cond(pred, fn, zeros)` with the skip branch returning
+    VMA-varying zeros of fn's output shapes — the ONE implementation of
+    the tick body's slot-skip pattern (loss, embed, fwd, bwd slots), so
+    the _vary handling cannot diverge between them. Only legal when `fn`
+    contains no collectives (the predicate is device-varying)."""
+    shapes = jax.eval_shape(fn, args)
+
+    def skip(_):
+        return jax.tree_util.tree_map(
+            lambda s: _vary(jnp.zeros(s.shape, s.dtype)), shapes
+        )
+
+    return jax.lax.cond(pred, fn, skip, args)
+
+
 def default_finalize(tick_stats, gate, ctx):
     """Sum-decomposed stats: every leaf is a per-microbatch SUM
     contribution; the final stat is the GRAD_AXES psum of the gated tick
@@ -203,6 +219,13 @@ def make_1f1b_grad_fn(
         all(mesh_shape.get(ax, 1) == 1 for ax in ("fsdp", "tensor"))
         and not loss_collectives
     )
+    # The r4 ramp skip for the stage fwd/bwd slots additionally requires a
+    # collective-free stage body: under PP x SP the stage runs RING
+    # attention (sequence-axis ppermutes), which may not sit under the
+    # pipe-varying cond — there the always-compute slots stay. (The
+    # loss/embed conds are unaffected: CE loss_mb and the embed lookup
+    # carry no collectives.)
+    slot_conds = full_manual and mesh_shape.get("sequence", 1) == 1
 
     def embed_apply(rest, tok, pos):
         return model.apply({"params": rest}, tok, pos, method=model.embed)
@@ -273,7 +296,22 @@ def make_1f1b_grad_fn(
             pos_f = jax.lax.dynamic_index_in_dim(pos_mbs, fi, 0, keepdims=False)
             x0 = embed_apply(rest, tok_f, pos_f)
             x_in = jnp.where(idx == 0, x0, recv_h)
-            y = stage_fwd(my_layers, x_in, mask_f, pos_f)
+            # Ramp ticks skip the stage forward entirely (lax.cond, like
+            # the loss/embed slots): during fill/drain a stage then pays
+            # only the slot it actually runs, so the engine's wall ramp is
+            # ~(S-1) single-width ticks each side — Megatron-1F1B's ideal
+            # bubble (S-1)/(M+S-1) — instead of 2(S-1) full double-slot
+            # ticks. Full-manual, sequence-free meshes only; under auto
+            # axes or PP x SP (ring attention's sequence ppermutes) the
+            # branch would wrap collectives in a device-varying predicate.
+            if slot_conds:
+                y = cond_or_zeros(
+                    valid_f,
+                    lambda a: stage_fwd(my_layers, a[0], a[1], a[2]),
+                    (x_in, mask_f, pos_f),
+                )
+            else:
+                y = stage_fwd(my_layers, x_in, mask_f, pos_f)
             # stash this stage's INPUT (slot RS is the bubble trash can)
             slot = jnp.where(valid_f, jnp.mod(f, RS), RS)
             stash = jax.lax.dynamic_update_index_in_dim(
@@ -314,15 +352,8 @@ def make_1f1b_grad_fn(
 
             loss_args = (y, tok_b, mask_b, mb_batch_b)
             if full_manual:
-                out_shapes = jax.eval_shape(loss_slot, loss_args)
-
-                def loss_skip(args):
-                    return jax.tree_util.tree_map(
-                        lambda s: _vary(jnp.zeros(s.shape, s.dtype)), out_shapes
-                    )
-
-                l, tick_stats, dl_rest, dl_heads, dy_last = jax.lax.cond(
-                    last & valid_b, loss_slot, loss_skip, loss_args
+                l, tick_stats, dl_rest, dl_heads, dy_last = cond_or_zeros(
+                    last & valid_b, loss_slot, loss_args
                 )
             else:
                 l, tick_stats, dl_rest, dl_heads, dy_last = loss_slot(loss_args)
@@ -331,10 +362,22 @@ def make_1f1b_grad_fn(
                 stash, jnp.mod(bi, RS), 0, keepdims=False
             )
             dy = jnp.where(idx == S - 1, dy_last, recv_dx)
-            _, s_vjp = jax.vjp(
-                lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b), my_layers, x_b
-            )
-            d_lp, dx = s_vjp(dy)
+            if slot_conds:
+                # same ramp skip for the backward slot (see fwd note)
+                def bwd_slot(args):
+                    x_, dy_, mask_, pos_ = args
+                    _, s_vjp = jax.vjp(
+                        lambda lp, xx: stage_fwd(lp, xx, mask_, pos_),
+                        my_layers, x_,
+                    )
+                    return s_vjp(dy_)
+
+                d_lp, dx = cond_or_zeros(valid_b, bwd_slot, (x_b, dy, mask_b, pos_b))
+            else:
+                _, s_vjp = jax.vjp(
+                    lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b), my_layers, x_b
+                )
+                d_lp, dx = s_vjp(dy)
 
             # embed backward on stage 0: dx is the cotangent of this
             # stage's input == the embed output
@@ -347,16 +390,7 @@ def make_1f1b_grad_fn(
 
             embed_args = (tok_b, pos_b, dx)
             if full_manual:
-                rest_shapes = jax.eval_shape(embed_slot, embed_args)
-
-                def embed_skip(args):
-                    return jax.tree_util.tree_map(
-                        lambda s: _vary(jnp.zeros(s.shape, s.dtype)), rest_shapes
-                    )
-
-                de_rest = jax.lax.cond(
-                    first & valid_b, embed_slot, embed_skip, embed_args
-                )
+                de_rest = cond_or_zeros(first & valid_b, embed_slot, embed_args)
             else:
                 de_rest = embed_slot(embed_args)
 

@@ -39,13 +39,12 @@ def test_conditional_slots_reach_ideal_1f1b_bubble(S, M):
 
 
 @pytest.mark.parametrize("S,M,v", [(4, 8, 2), (4, 32, 2), (4, 32, 4), (8, 32, 2)])
-def test_lockstep_interleaved_1f1b_with_conditional_slots_pays(S, M, v):
-    """With conditional slots the picture CHANGES: a lockstep interleaved
-    1F1B simulates BELOW plain 1F1B's bubble at near-flat residency — the
-    r3 refusal's 'chunking cancels' argument only held for always-both
-    ticks. The composition is now the documented next engine extension
-    (it needs per-chunk stash addressing and ring-wrap chains), no longer
-    a cancelled win."""
+def test_interleaved_1f1b_with_conditional_slots_pays(S, M, v):
+    """With conditional slots the picture CHANGES: interleaved 1F1B
+    simulates BELOW plain 1F1B's bubble at near-flat residency — the r3
+    refusal's 'chunking cancels' argument only held for always-both
+    ticks. This measured payoff is why r4 SHIPPED the composition
+    (onef1b.py n_virtual > 1; grad parity in tests/test_onef1b.py)."""
     plain = onef1b(S, M)
     inter = onef1b_interleaved_lockstep(S, M, v)
     assert inter.bubble_fraction <= plain.bubble_fraction + 1e-9

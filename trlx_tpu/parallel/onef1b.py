@@ -28,10 +28,13 @@ tick via the down/up ppermute pair), and the in-flight window at stage i
 is 2(S - i) - 1 microbatches, bounded by 2S - 1 *independent of M*.
 
 Because the schedule is hand-written, so is the backward: each stage
-stashes only its INPUT activation per in-flight microbatch (a ring buffer
-of min(2S-1, M) slots) and the backward tick recomputes the stage forward
-under `jax.vjp` — the same recompute cost autodiff-with-remat pays, but
-with residual lifetime bounded by the schedule instead of the scan.
+stashes only its INPUT activation per in-flight microbatch — a ring
+buffer of min(2S-1, M) slots keyed by microbatch index at v=1, or
+2Sv-1 slots keyed by forward tick under interleaving (live span
+<= 2Sv-2 ticks, so tick-keying never collides) — and the backward tick
+recomputes the stage forward under `jax.vjp`: the same recompute cost
+autodiff-with-remat pays, but with residual lifetime bounded by the
+schedule instead of the scan.
 Gradients accumulate in the scan carry; the final psum over the data
 (and, under PP x SP, sequence) axes replaces the transpose-inserted
 collectives of the autodiff path.
@@ -173,13 +176,31 @@ def make_1f1b_grad_fn(
     # ILQL SP path's sequence all_gather of V) — forces the predicated
     # always-compute loss slot, since a collective may not sit under the
     # lax.cond fast path (its predicate is pipe-varying)
+    n_virtual: int = 1,  # interleaved virtual stages per device (the
+    # Megatron virtual-PP chunking): device d holds chunks l*S + d for
+    # l < n_virtual, a microbatch crosses S*v chunk-stages, and the
+    # generalized tick algebra below reduces EXACTLY to the plain engine
+    # at v=1 (one code path — the v=1 tests validate the reduction)
 ) -> Callable:
     """Build fn(stacked, rest, heads, tokens, attn_mask, batch) ->
     (loss, stats, (d_stacked, d_rest, d_heads)).
 
     - `stacked`: [n_stages, lps, ...] block pytree sharded over "pipe"
-      (the permanent pipelined-trainer layout; interleaved layouts are not
-      supported — the virtual-stage ring would need a second schedule).
+      (the permanent pipelined-trainer layout), or
+      [n_stages, n_virtual, lps, ...] for the interleaved layout.
+
+    INTERLEAVED 1F1B (n_virtual = v > 1): chunk-stage k = l*S + d lives
+    on device d; microbatch m's forward crosses k = 0..Sv-1 at tick
+    t_F = E(m) + k with E(m) = (m mod S) + (m div S)*S*v (the wave
+    spacing of parallel/pipeline.py interleaved_blocks), and the backward
+    of chunk-stage k runs at t_B = E(m) + 2Sv-2 - k. The last chunk-stage
+    runs loss + backward on its own forward tick (t_F = t_B there), the
+    fwd/bwd rings WRAP (chunk l on device S-1 feeds chunk l+1 on device
+    0), the stash keys chunk inputs by their forward tick mod (2Sv-1)
+    (live span <= 2Sv-2, so no collision), and chunk gradients accumulate
+    into the [v, lps, ...] slice of the carry. Cost: ~v x the stashed
+    chunk activations of plain 1F1B; payoff: the measured ~1/v bubble
+    (schedule_analysis.onef1b_interleaved_lockstep).
     - `rest`: non-block LM params (embeddings/ln_f/lm_head), replicated
       over the manual axes (fsdp/tensor shard them under GSPMD-auto).
     - `heads`: pytree of extra head params the loss consumes (e.g.
@@ -204,10 +225,21 @@ def make_1f1b_grad_fn(
     mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     S = mesh_shape[PIPE_AXIS]
     M = int(n_microbatches)
-    RS = min(2 * S - 1, M)  # ring-stash slots; in-flight span at stage i is
-    # 2(S-i)-1, and valid (f, b) pairs obey f - b = 2S-2-2i < RS, so slot
-    # f % RS never collides with a live b % RS (+1 trash slot for bubbles)
-    n_ticks = M + 2 * S - 2
+    v = int(n_virtual)
+    Sv = S * v
+    D = 2 * Sv - 2  # fwd->bwd tick distance of chunk-stage 0
+    if v == 1:
+        # microbatch-keyed stash (slot = m mod RS): live (f, b) pairs obey
+        # f - b < 2S-1, so min(2S-1, M) slots suffice — the tight bound for
+        # M < ramp configurations
+        RS = min(2 * S - 1, M)
+    else:
+        # forward-tick-keyed stash (slot = t_F mod RS): a chunk input born
+        # at t_F is consumed at t_F + D - 2k <= t_F + D, so D + 1 slots
+        # never collide between live entries (chunk index alone is not a
+        # key — device d holds v in-flight chunks per microbatch)
+        RS = D + 1
+    n_ticks = ((M - 1) % S) + ((M - 1) // S) * Sv + 2 * Sv - 1
     # With no GSPMD-auto axis active, the loss head (unembed+loss fwd+vjp,
     # the d x V matmuls) and the embed vjp can run under lax.cond so only
     # the one stage that keeps the result pays for it — removing the ~S x
@@ -232,8 +264,9 @@ def make_1f1b_grad_fn(
 
     def inner(stacked, rest, heads, tokens, attn_mask, positions, batch):
         idx = jax.lax.axis_index(PIPE_AXIS)
+        # v == 1: [lps, ...] layer stack; v > 1: [v, lps, ...] chunk stack
         my_layers = jax.tree_util.tree_map(lambda x: x[0], stacked)
-        lps = jax.tree_util.tree_leaves(my_layers)[0].shape[0]
+        lps = jax.tree_util.tree_leaves(my_layers)[0].shape[0 if v == 1 else 1]
         # CRITICAL: the vjps below must see device-VARYING params. Inside a
         # manual shard_map, jax.vjp w.r.t. an invariant (replicated) input
         # auto-inserts a psum over the manual axes so the cotangent can be
@@ -259,11 +292,21 @@ def make_1f1b_grad_fn(
             lambda x: x.reshape(M, x.shape[0] // M, *x.shape[1:]), batch
         )
 
-        def stage_fwd(layers, x, mask, pos):
+        def stage_fwd(layers, x, mask, pos, layer_offset):
             bias = train_bias(cfg, mask)
             return _apply_layer_stack(
                 cfg, layers, x, bias, pos, mask,
-                layer_offset=idx * lps, freeze_split=freeze_split,
+                layer_offset=layer_offset, freeze_split=freeze_split,
+            )
+
+        def chunk_at(l):
+            """This device's chunk l of the layer stack (static slice at
+            v == 1, so the plain engine pays no gather)."""
+            if v == 1:
+                return my_layers
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.dynamic_index_in_dim(x, l, 0, keepdims=False),
+                my_layers,
             )
 
         def loss_head(rest_, heads_, h_, tok, mask, mb_batch):
@@ -277,8 +320,12 @@ def make_1f1b_grad_fn(
         )
         act = lambda: jnp.zeros(h_shape.shape, h_shape.dtype)
 
-        fwd_perm = [(s, s + 1) for s in range(S - 1)]
-        bwd_perm = [(s, s - 1) for s in range(1, S)]
+        # ring permutes WRAP (chunk-stage k on device S-1 feeds k+1 on
+        # device 0); at v == 1 the wrapped edge's payload is never consumed
+        # (device 0 always takes the embed input), matching the old
+        # line-permute semantics
+        fwd_perm = [(s, (s + 1) % S) for s in range(S)]
+        bwd_perm = [(s, (s - 1) % S) for s in range(S)]
 
         zero_grads = jax.tree_util.tree_map(
             jnp.zeros_like, (my_layers, rest, heads)
@@ -287,15 +334,26 @@ def make_1f1b_grad_fn(
         def tick(carry, r):
             recv_h, recv_dx, stash, d_layers, d_rest, d_heads, loss_acc = carry
 
-            # ---------------- forward slot: microbatch f ----------------
-            f = r - idx
-            valid_f = (f >= 0) & (f < M)
-            fi = jnp.clip(f, 0, M - 1)
+            # ------ forward slot: (microbatch m_f, chunk l_f) ------
+            # chunk-stage k = l*S + idx runs microbatch m's forward at tick
+            # E(m) + k with E(m) = (m mod S) + (m div S)*Sv; inverting for
+            # this device: base/w/q as in pipeline.py interleaved_blocks
+            # (q == k, and q = idx when v == 1 — the plain schedule)
+            base = jnp.mod(r - idx, S)
+            w = (r - base) // Sv
+            m_f = base + w * S
+            q = r - (jnp.mod(m_f, S) + (m_f // S) * Sv)
+            valid_f = (m_f >= 0) & (m_f < M) & (q >= 0) & (q < Sv)
+            l_f = 0 if v == 1 else jnp.clip(q // S, 0, v - 1)
+            fi = jnp.clip(m_f, 0, M - 1)
             tok_f = jax.lax.dynamic_index_in_dim(tok_mbs, fi, 0, keepdims=False)
             mask_f = jax.lax.dynamic_index_in_dim(mask_mbs, fi, 0, keepdims=False)
             pos_f = jax.lax.dynamic_index_in_dim(pos_mbs, fi, 0, keepdims=False)
             x0 = embed_apply(rest, tok_f, pos_f)
-            x_in = jnp.where(idx == 0, x0, recv_h)
+            first_f = (idx == 0) if v == 1 else ((idx == 0) & (l_f == 0))
+            x_in = jnp.where(first_f, x0, recv_h)
+            chunk_f = chunk_at(l_f)
+            off_f = (l_f * S + idx) * lps
             # Ramp ticks skip the stage forward entirely (lax.cond, like
             # the loss/embed slots): during fill/drain a stage then pays
             # only the slot it actually runs, so the engine's wall ramp is
@@ -307,21 +365,43 @@ def make_1f1b_grad_fn(
             if slot_conds:
                 y = cond_or_zeros(
                     valid_f,
-                    lambda a: stage_fwd(my_layers, a[0], a[1], a[2]),
+                    lambda a: stage_fwd(chunk_f, a[0], a[1], a[2], off_f),
                     (x_in, mask_f, pos_f),
                 )
             else:
-                y = stage_fwd(my_layers, x_in, mask_f, pos_f)
-            # stash this stage's INPUT (slot RS is the bubble trash can)
-            slot = jnp.where(valid_f, jnp.mod(f, RS), RS)
+                y = stage_fwd(chunk_f, x_in, mask_f, pos_f, off_f)
+            # stash this chunk-stage's INPUT — keyed by microbatch at v=1,
+            # by forward tick at v>1 (slot RS is the bubble trash can)
+            key_f = m_f if v == 1 else r
+            slot = jnp.where(valid_f, jnp.mod(key_f, RS), RS)
             stash = jax.lax.dynamic_update_index_in_dim(
                 stash, x_in, slot, 0
             )
 
-            # ---------- loss + backward slot: microbatch b ----------
-            b = r - (2 * S - 2) + idx
-            valid_b = (b >= 0) & (b < M)
-            bi = jnp.clip(b, 0, M - 1)
+            # ------ loss + backward slot: (m_b, chunk l_b) ------
+            # backward of chunk-stage k runs at E(m) + D - k; invert per
+            # candidate chunk l (v is small and static — unrolled)
+            if v == 1:
+                b = r - D + idx
+                valid_b = (b >= 0) & (b < M)
+                m_b = b
+                l_b = 0
+                k_b = idx
+            else:
+                vals, ms, ls = [], [], []
+                for l in range(v):
+                    c_l = r - D + l * S + idx
+                    beta = jnp.mod(c_l, Sv)
+                    m_l = beta + (c_l // Sv) * S
+                    val_l = (c_l >= 0) & (beta < S) & (m_l < M)
+                    vals.append(val_l)
+                    ms.append(jnp.where(val_l, m_l, 0))
+                    ls.append(jnp.where(val_l, l, 0))
+                valid_b = functools.reduce(jnp.logical_or, vals)
+                m_b = sum(ms)
+                l_b = sum(ls)
+                k_b = l_b * S + idx
+            bi = jnp.clip(m_b, 0, M - 1)
             tok_b = jax.lax.dynamic_index_in_dim(tok_mbs, bi, 0, keepdims=False)
             mask_b = jax.lax.dynamic_index_in_dim(mask_mbs, bi, 0, keepdims=False)
             pos_b = jax.lax.dynamic_index_in_dim(pos_mbs, bi, 0, keepdims=False)
@@ -330,13 +410,12 @@ def make_1f1b_grad_fn(
                 batch_mbs,
             )
 
-            last = idx == S - 1
-            first = idx == 0
+            # loss fires on the LAST chunk-stage (k = Sv-1), whose backward
+            # tick IS its forward tick (t_F = t_B there), so `y` is that
+            # microbatch's final hidden state; embed grads on chunk-stage 0
+            last = (idx == S - 1) if v == 1 else ((idx == S - 1) & (l_b == v - 1))
+            first = (idx == 0) if v == 1 else ((idx == 0) & (l_b == 0))
 
-            # On the last stage b == f, so `y` IS microbatch b's final
-            # hidden state; elsewhere (and on bubble ticks) the result is
-            # skipped via lax.cond on full-manual meshes, or computed and
-            # predicated away where auto axes forbid the cond.
             def loss_slot(args):
                 y_, tok_, mask_, mbb = args
                 l, lh_vjp, tick_stats = jax.vjp(
@@ -358,28 +437,35 @@ def make_1f1b_grad_fn(
             else:
                 l, tick_stats, dl_rest, dl_heads, dy_last = loss_slot(loss_args)
 
+            # read back the stashed chunk input: v=1 keyed by microbatch;
+            # v>1 keyed by its forward tick t_F = E(m_b) + k_b = r - D + 2*k_b
+            key_b = bi if v == 1 else jnp.mod(r - D + 2 * k_b, RS)
             x_b = jax.lax.dynamic_index_in_dim(
-                stash, jnp.mod(bi, RS), 0, keepdims=False
+                stash, jnp.mod(key_b, RS), 0, keepdims=False
             )
-            dy = jnp.where(idx == S - 1, dy_last, recv_dx)
+            dy_from_loss = (idx == S - 1) if v == 1 else (k_b == Sv - 1)
+            dy = jnp.where(dy_from_loss, dy_last, recv_dx)
+            chunk_b = chunk_at(l_b)
+            off_b = (l_b * S + idx) * lps
             if slot_conds:
                 # same ramp skip for the backward slot (see fwd note)
                 def bwd_slot(args):
                     x_, dy_, mask_, pos_ = args
                     _, s_vjp = jax.vjp(
-                        lambda lp, xx: stage_fwd(lp, xx, mask_, pos_),
-                        my_layers, x_,
+                        lambda lp, xx: stage_fwd(lp, xx, mask_, pos_, off_b),
+                        chunk_b, x_,
                     )
                     return s_vjp(dy_)
 
                 d_lp, dx = cond_or_zeros(valid_b, bwd_slot, (x_b, dy, mask_b, pos_b))
             else:
                 _, s_vjp = jax.vjp(
-                    lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b), my_layers, x_b
+                    lambda lp, x_: stage_fwd(lp, x_, mask_b, pos_b, off_b),
+                    chunk_b, x_b,
                 )
                 d_lp, dx = s_vjp(dy)
 
-            # embed backward on stage 0: dx is the cotangent of this
+            # embed backward on chunk-stage 0: dx is the cotangent of this
             # stage's input == the embed output
             def embed_slot(args):
                 tok_, pos_, dx_ = args
@@ -394,10 +480,22 @@ def make_1f1b_grad_fn(
             else:
                 de_rest = embed_slot(embed_args)
 
-            # jnp.where (not gate-multiply): bubble slots may hold inf/nan
-            d_layers = jax.tree_util.tree_map(
-                lambda acc, g: acc + jnp.where(valid_b, g, 0.0), d_layers, d_lp
-            )
+            # jnp.where (not gate-multiply): bubble slots may hold inf/nan;
+            # chunk grads land in the l_b-th slice of the [v, lps, ...] carry
+            if v == 1:
+                d_layers = jax.tree_util.tree_map(
+                    lambda acc, g: acc + jnp.where(valid_b, g, 0.0), d_layers, d_lp
+                )
+            else:
+                d_layers = jax.tree_util.tree_map(
+                    lambda acc, g: jax.lax.dynamic_update_index_in_dim(
+                        acc,
+                        jax.lax.dynamic_index_in_dim(acc, l_b, 0, keepdims=False)
+                        + jnp.where(valid_b, g, 0.0),
+                        l_b, 0,
+                    ),
+                    d_layers, d_lp,
+                )
             d_rest = jax.tree_util.tree_map(
                 lambda acc, gl, ge: acc
                 + jnp.where(valid_b & last, gl, 0.0)

@@ -375,12 +375,6 @@ class PipelinedCausalMixin:
                 f"parallel.pipeline_schedule must be 'gpipe' or '1f1b', "
                 f"got {schedule!r}"
             )
-        if self._n_virtual != 1:
-            raise NotImplementedError(
-                "pipeline_schedule='1f1b' does not compose with "
-                "pipeline_interleave > 1 (the virtual-stage ring would need "
-                "a second schedule); use 'gpipe' for interleaved PP"
-            )
         from flax import traverse_util
 
         from trlx_tpu.models.transformer import TransformerLM
@@ -404,6 +398,7 @@ class PipelinedCausalMixin:
             finalize_fn=parts.get("finalize_fn", default_finalize),
             freeze_split=self._freeze_split(),
             loss_collectives=parts.get("loss_collectives", False),
+            n_virtual=self._n_virtual,
         )
         prepare = parts["prepare"]
         wrap_stats = parts.get("wrap_stats", lambda loss, stats: stats)

@@ -93,7 +93,7 @@ def gpipe_interleaved(S: int, M: int, v: int) -> ScheduleStats:
     # useful chunk-ticks: M microbatches x S*v chunks, each 1/v width
     work = (M * S * v) * (1.0 / v) + (M * S * v) * (BWD_WEIGHT / v)
     total = S * (fwd_ticks * (1.0 / v) + bwd_ticks * (BWD_WEIGHT / v))
-    return ScheduleStats(f"gpipe+interleave", S, M, v, work, total, M)
+    return ScheduleStats("gpipe+interleave", S, M, v, work, total, M)
 
 
 def onef1b(S: int, M: int, conditional_slots: bool = True) -> ScheduleStats:

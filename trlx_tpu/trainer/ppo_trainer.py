@@ -604,7 +604,20 @@ class PPOTrainer(TPUTrainer):
             # decoder-relative windows (start 0); response carries the
             # decoder start token at position 0, so the valid-response
             # count looks at positions 1: (mirrors _chunk_to_elements'
-            # n_resp = max(len(outputs[ix]), 1))
+            # n_resp = max(len(outputs[ix]), 1)).
+            # Deliberate divergence from reference seq2seq make_experience
+            # (accelerate_ppo_trainer.py:470-486): the reference places the
+            # scalar score at ends = n_nonpad + 1 (one slot PAST the last
+            # real token, landing on a pad position) and masks log_ratio
+            # with attention_mask[:, :-1] (the ENCODER mask, one position
+            # shifted). Both read as off-by-one artifacts of its torch
+            # indexing; here the score lands on the last real response
+            # token (j == n_resp - 1) and the KL mask is the decoder mask
+            # shifted with the labels (decoder_attention_mask[:, 1:]),
+            # consistent with this repo's _chunk_to_elements and with the
+            # causal path below. Curve parity is asserted on the causal
+            # path (PARITY_CURVES.json); seq2seq bit-parity with the
+            # reference's indexing is explicitly not a goal.
             def score_reward_s2s(train_params, frozen_params, ref_params,
                                  prompt_tensors, sample_outputs, scores_eff,
                                  kl_coef):
@@ -878,15 +891,17 @@ class PPOTrainer(TPUTrainer):
             (self.generate_experience_kwargs or self.generate_kwargs)
             .get("max_new_tokens", 40)
         )
-        use_spec = self._spec_path_available()
-
         def dispatch_chunks():
             # all generations enqueue first, then the speculative scorers —
             # the fetch waits on gens + (tiny) trims, so the score forwards
-            # overlap the fetch RTT and host reward scoring
+            # overlap the fetch RTT and host reward scoring.
+            # Availability is re-checked at every dispatch: once a dense
+            # reward_fn flips _spec_disabled_dense mid-cycle, no further
+            # speculative forwards are wasted.
+            spec_ok = self._spec_path_available()
             gens = [self.dispatch_rollout_generation() for _ in range(k)]
             specs = [
-                self._dispatch_spec_score(o) if use_spec else None
+                self._dispatch_spec_score(o) if spec_ok else None
                 for _, o in gens
             ]
             return gens, specs
@@ -895,6 +910,8 @@ class PPOTrainer(TPUTrainer):
             gens, specs = dispatch_chunks()
             pending = (gens, specs, None)
         gens, specs, prev = pending
+        # what was actually dispatched last cycle, not current availability
+        use_spec = specs[0] is not None
 
         # The cycle's single blocking fetch: every chunk's raw samples
         # (+ the speculative trims for arbitration) + the previous cycle's
@@ -941,6 +958,7 @@ class PPOTrainer(TPUTrainer):
 
             spec_hit = (
                 spec is not None
+                and spec_trimmed is not None
                 and scalar  # dense rewards recheck widths; keep the fast path simple
                 and spec_trimmed.shape == sample_outputs.shape
                 and np.array_equal(spec_trimmed, sample_outputs)

@@ -1,3 +1,14 @@
 """Example scripts (capability parity with the reference's examples/ —
 SURVEY.md §2.8). A regular package so it always resolves to this repo even
 when the reference tree is on sys.path (tests/reference_oracle.py)."""
+
+import os as _os
+
+
+def local_model_or(default_preset: str, default_tokenizer: str = "byte"):
+    """(model_path, tokenizer_path): TRLX_TPU_MODEL_DIR when it points at a
+    real checkpoint directory, else the offline-safe preset + tokenizer."""
+    local = _os.environ.get("TRLX_TPU_MODEL_DIR")
+    if local and _os.path.isdir(local):
+        return local, local
+    return default_preset, default_tokenizer

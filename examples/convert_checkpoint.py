@@ -26,19 +26,34 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 
+def _value_head_model(cfg):
+    """Family dispatch + init args, shared by both directions."""
+    import jax.numpy as jnp
+
+    from trlx_tpu.models import CausalLMWithValueHead, Seq2SeqLMWithValueHead
+
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    if getattr(cfg, "is_seq2seq", False):
+        model = Seq2SeqLMWithValueHead(cfg)
+        args = (tokens, jnp.ones_like(tokens), tokens, jnp.ones_like(tokens))
+    else:
+        model = CausalLMWithValueHead(cfg)
+        args = (tokens, jnp.ones_like(tokens))
+    return model, args
+
+
 def to_tpu(src: str, out: str) -> None:
     import jax
     import jax.numpy as jnp
     from flax import serialization
 
-    from trlx_tpu.models import CausalLMWithValueHead, hf_interop
+    from trlx_tpu.models import hf_interop
 
     cfg = hf_interop.config_from_hf(src, dtype=jnp.bfloat16)
-    model = CausalLMWithValueHead(cfg)
-    tokens = jnp.zeros((1, 8), jnp.int32)
+    model, init_args = _value_head_model(cfg)
     # real init, not eval_shape: the head (and any adapter) leaves are kept
     # from the template and must be materialized arrays for serialization
-    template = model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))["params"]
+    template = model.init(jax.random.PRNGKey(0), *init_args)["params"]
     params = hf_interop.load_params_from_hf(src, cfg, template)
 
     os.makedirs(out, exist_ok=True)
@@ -64,17 +79,16 @@ def to_hf(src: str, out: str) -> None:
     import torch
     from flax import serialization
 
-    from trlx_tpu.models import CausalLMWithValueHead, hf_interop
+    from trlx_tpu.models import hf_interop
 
     if not os.path.exists(os.path.join(src, "config.json")):
         sys.exit("to-hf needs the HF config.json alongside params.msgpack "
                  "(to-tpu copies it into its output dir)")
     cfg = hf_interop.config_from_hf(src)
-    model = CausalLMWithValueHead(cfg)
-    tokens = jnp.zeros((1, 8), jnp.int32)
+    model, init_args = _value_head_model(cfg)
     # from_bytes only needs structure, so the shape-only template suffices
     template = jax.eval_shape(
-        lambda: model.init(jax.random.PRNGKey(0), tokens, jnp.ones_like(tokens))
+        lambda: model.init(jax.random.PRNGKey(0), *init_args)
     )["params"]
     with open(os.path.join(src, "params.msgpack"), "rb") as f:
         params = serialization.from_bytes(template, f.read())

@@ -59,9 +59,13 @@ def chrf_proxy(output: str, reference: str, n: int = 2) -> float:
     return 0.0 if p + rec == 0 else 2 * p * rec / (p + rec)
 
 
+from examples import local_model_or
+
+_model_path, _tokenizer_path = local_model_or("random:t5-tiny")
+
 default_config = default_ppo_config().evolve(
-    model=dict(model_path="random:t5-tiny", model_arch_type="seq2seq"),
-    tokenizer=dict(tokenizer_path="byte", padding_side="right"),
+    model=dict(model_path=_model_path, model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path=_tokenizer_path, padding_side="right"),
     train=dict(seq_length=96, batch_size=16, total_steps=200, tracker=None,
                checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_translation_t5"),
     method=dict(

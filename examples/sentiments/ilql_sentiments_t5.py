@@ -11,9 +11,13 @@ from examples.sentiments import PROMPTS, metric_fn, offline_samples
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ilql_config
 
+from examples import local_model_or
+
+_model_path, _tokenizer_path = local_model_or("random:t5-tiny")
+
 default_config = default_ilql_config().evolve(
-    model=dict(model_path="random:t5-tiny", model_arch_type="seq2seq"),
-    tokenizer=dict(tokenizer_path="byte"),
+    model=dict(model_path=_model_path, model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path=_tokenizer_path),
     train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
                checkpoint_dir="/tmp/trlx_tpu_ckpts/ilql_sentiments_t5"),
     method=dict(gen_kwargs=dict(max_new_tokens=24, top_k=20, beta=1.0, temperature=1.0)),

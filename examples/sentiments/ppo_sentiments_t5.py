@@ -12,9 +12,16 @@ from examples.sentiments import PROMPTS, metric_fn, reward_fn
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.default_configs import default_ppo_config
 
+# TRLX_TPU_MODEL_DIR switches to a real T5/flan-t5 checkpoint directory
+# (loaded via models/hf_interop.py's t5 converter); the offline default is
+# a from-scratch tiny preset with a byte tokenizer.
+from examples import local_model_or
+
+model_path, tokenizer_path = local_model_or("random:t5-tiny")
+
 default_config = default_ppo_config().evolve(
-    model=dict(model_path="random:t5-tiny", model_arch_type="seq2seq"),
-    tokenizer=dict(tokenizer_path="byte"),
+    model=dict(model_path=model_path, model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path=tokenizer_path),
     train=dict(seq_length=64, batch_size=32, total_steps=200, tracker=None,
                checkpoint_dir="/tmp/trlx_tpu_ckpts/ppo_sentiments_t5"),
     method=dict(num_rollouts=64, chunk_size=32,

@@ -42,9 +42,13 @@ def rouge_proxy(samples, prompts, outputs, **kwargs):
     return scores
 
 
+from examples import local_model_or
+
+_model_path, _tokenizer_path = local_model_or("random:t5-tiny")
+
 default_config = default_ppo_config().evolve(
-    model=dict(model_path="random:t5-tiny", model_arch_type="seq2seq"),
-    tokenizer=dict(tokenizer_path="byte"),
+    model=dict(model_path=_model_path, model_arch_type="seq2seq"),
+    tokenizer=dict(tokenizer_path=_tokenizer_path),
     train=dict(seq_length=128, batch_size=16, total_steps=200, tracker=None,
                checkpoint_dir="/tmp/trlx_tpu_ckpts/summarize_daily_cnn_t5"),
     method=dict(num_rollouts=64, chunk_size=16,

@@ -51,9 +51,14 @@ def prompts(n: int = 64, seed: int = 2) -> List[str]:
 
 
 def summary_overlap_metric(samples: List[str], **kwargs):
-    """Fraction of the post's first-3 keywords recovered in the summary
-    (the task's ground-truth quality signal, used as eval metric_fn)."""
-    scores = []
+    """Eval metric_fn: ROUGE-1/2/L of the generated summary against the
+    task's ground-truth summary (the post's first-3 keywords) — the same
+    quality measure the reference publishes for summarize-RLHF
+    (examples/summarize_rlhf/README.md:50-55, computed there with HF
+    evaluate's rouge) — plus the simpler keyword-recovery fraction."""
+    from trlx_tpu.utils.rouge import rouge_metric
+
+    overlap, preds, refs = [], [], []
     for s in samples:
         if TLDR in s:
             post, summary = s.split(TLDR, 1)
@@ -61,8 +66,10 @@ def summary_overlap_metric(samples: List[str], **kwargs):
             post, summary = s, ""
         keywords = post.split()[:3]
         found = sum(k in summary.split() for k in keywords)
-        scores.append(found / max(len(keywords), 1))
-    return {"keyword_overlap": scores}
+        overlap.append(found / max(len(keywords), 1))
+        preds.append(summary)
+        refs.append(" ".join(keywords))
+    return {"keyword_overlap": overlap, **rouge_metric(preds, refs)}
 
 
 RM_PARAMS_PATH = "/tmp/trlx_tpu_ckpts/summarize_rm/rm_params.msgpack"

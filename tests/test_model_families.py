@@ -218,6 +218,182 @@ def test_sliding_window_decode_matches_forward():
         )
 
 
+# ---------------------------------------------------------------------------
+# T5 / seq2seq interop (reference loads t5 via PreTrainedModelWrapper.
+# from_pretrained, modeling_base.py:123-326, and wraps it with the branch
+# classes in modeling_ppo.py:1242-1592)
+# ---------------------------------------------------------------------------
+
+T5_VARIANTS = {
+    # t5 v1.0: relu MLP, tied embeddings, logits scaled by d_model**-0.5
+    "t5_v10": dict(feed_forward_proj="relu", tie_word_embeddings=True,
+                   num_decoder_layers=2),
+    # v1.1/flan-t5: gated-gelu, untied lm_head, no logit scaling, and an
+    # encoder/decoder depth mismatch + d_kv != d_model/n_heads
+    "flan_t5": dict(feed_forward_proj="gated-gelu", tie_word_embeddings=False,
+                    num_decoder_layers=3),
+    # plain (non-gated) gelu runs HF's exact-erf GELU, not gelu_new —
+    # pins the activation mapping divergence
+    "t5_gelu": dict(feed_forward_proj="gelu", tie_word_embeddings=True,
+                    num_decoder_layers=2),
+}
+
+
+def _tiny_t5(variant):
+    import transformers as tf
+
+    cfg = tf.T5Config(
+        vocab_size=VOCAB, d_model=32, d_kv=16, d_ff=64, num_layers=2,
+        num_heads=4, decoder_start_token_id=0, **T5_VARIANTS[variant],
+    )
+    torch.manual_seed(0)
+    model = tf.T5ForConditionalGeneration(cfg)
+    model.eval()
+    return model
+
+
+def _convert_t5(tmp_path, variant):
+    from trlx_tpu.models import Seq2SeqLMWithValueHead
+
+    hf_model = _tiny_t5(variant)
+    path = str(tmp_path / variant)
+    hf_model.save_pretrained(path, safe_serialization=True)
+    cfg = hf_interop.config_from_hf(path, dtype=jnp.float32)
+    assert cfg.is_seq2seq and cfg.hf_family == "t5"
+    model = Seq2SeqLMWithValueHead(cfg)
+    tok = jnp.zeros((1, 8), jnp.int32)
+    template = model.init(
+        jax.random.PRNGKey(0), tok, jnp.ones_like(tok), tok, jnp.ones_like(tok)
+    )["params"]
+    params = hf_interop.load_params_from_hf(path, cfg, template)
+    return hf_model, cfg, model, params, path
+
+
+def _t5_logits(model, params, enc, enc_mask, dec, dec_mask):
+    logits, _, _, _ = model.apply(
+        {"params": params},
+        jnp.asarray(enc, jnp.int32), jnp.asarray(enc_mask, jnp.int32),
+        jnp.asarray(dec, jnp.int32), jnp.asarray(dec_mask, jnp.int32), 0,
+    )
+    return np.asarray(logits, np.float32)
+
+
+@pytest.mark.parametrize("variant", sorted(T5_VARIANTS))
+def test_t5_logits_parity(tmp_path, variant, rng):
+    """Encoder+decoder logits parity vs the torch oracle, with encoder
+    right-padding (T5 tokenizers pad right) exercising the padding bias."""
+    hf_model, cfg, model, params, _ = _convert_t5(tmp_path, variant)
+
+    enc = rng.integers(3, VOCAB, size=(2, 12))
+    enc_mask = np.ones((2, 12), dtype=np.int64)
+    enc_mask[1, 9:] = 0
+    dec = rng.integers(3, VOCAB, size=(2, 7))
+    dec[:, 0] = cfg.decoder_start_token_id
+    dec_mask = np.ones((2, 7), dtype=np.int64)
+
+    with torch.no_grad():
+        ref = hf_model(
+            input_ids=torch.tensor(enc), attention_mask=torch.tensor(enc_mask),
+            decoder_input_ids=torch.tensor(dec),
+            decoder_attention_mask=torch.tensor(dec_mask),
+        ).logits.numpy()
+    ours = _t5_logits(model, params, enc, enc_mask, dec, dec_mask)
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("variant", sorted(T5_VARIANTS))
+def test_t5_export_round_trip(tmp_path, variant, rng):
+    """params -> HF state dict matches the original checkpoint tensors, and
+    the exported dir (config_to_hf + torch.save) loads back through plain
+    transformers AutoModelForSeq2SeqLM with identical logits — the
+    save_pretrained contract (reference modeling_base.py:327-374)."""
+    import json as _json
+
+    hf_model, cfg, model, params, _ = _convert_t5(tmp_path, variant)
+    sd = hf_interop.params_to_hf_state_dict(params, cfg)
+
+    orig = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    checked = 0
+    for k, v in orig.items():
+        if k in sd:
+            np.testing.assert_allclose(sd[k], v, atol=1e-6, err_msg=k)
+            checked += 1
+    assert checked >= len(orig)  # every original tensor is covered
+
+    out = tmp_path / f"{variant}_export"
+    out.mkdir()
+    torch.save(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+        str(out / "pytorch_model.bin"),
+    )
+    with open(out / "config.json", "w") as f:
+        _json.dump(hf_interop.config_to_hf(cfg), f)
+
+    from transformers import AutoModelForSeq2SeqLM
+
+    reloaded = AutoModelForSeq2SeqLM.from_pretrained(str(out))
+    reloaded.eval()
+    enc = rng.integers(3, VOCAB, size=(1, 10))
+    dec = rng.integers(3, VOCAB, size=(1, 5))
+    dec[:, 0] = cfg.decoder_start_token_id
+    ones_e, ones_d = np.ones_like(enc), np.ones_like(dec)
+    with torch.no_grad():
+        a = hf_model(
+            input_ids=torch.tensor(enc), attention_mask=torch.tensor(ones_e),
+            decoder_input_ids=torch.tensor(dec),
+            decoder_attention_mask=torch.tensor(ones_d),
+        ).logits.numpy()
+        b = reloaded(
+            input_ids=torch.tensor(enc), attention_mask=torch.tensor(ones_e),
+            decoder_input_ids=torch.tensor(dec),
+            decoder_attention_mask=torch.tensor(ones_d),
+        ).logits.numpy()
+    np.testing.assert_allclose(b, a, atol=1e-5, rtol=1e-5)
+
+
+def test_t5_hydra_split_parity(tmp_path, rng):
+    """forward_seq2seq_policy_and_ref with split>0 (frozen top decoder
+    branch resumed from the trunk's hidden state) must equal the full
+    frozen forward on real converted weights — the T5Branch contract
+    (reference modeling_ppo.py:1353-1592)."""
+    from trlx_tpu.models import (
+        forward_seq2seq_policy_and_ref,
+        seq2seq_ref_param_subtree,
+    )
+
+    hf_model, cfg, model, params, _ = _convert_t5(tmp_path, "flan_t5")
+    split = cfg.n_decoder_layers - 1
+    ref_sub = seq2seq_ref_param_subtree(params, cfg, split)
+    ref_full = seq2seq_ref_param_subtree(params, cfg, 0)
+
+    enc = rng.integers(3, VOCAB, size=(2, 10))
+    dec = rng.integers(3, VOCAB, size=(2, 6))
+    dec[:, 0] = cfg.decoder_start_token_id
+    enc_mask, dec_mask = np.ones_like(enc), np.ones_like(dec)
+    args = (jnp.asarray(enc, jnp.int32), jnp.asarray(enc_mask, jnp.int32),
+            jnp.asarray(dec, jnp.int32), jnp.asarray(dec_mask, jnp.int32))
+
+    _, _, ref_logits_split = forward_seq2seq_policy_and_ref(
+        model, params, ref_sub, *args, split
+    )
+    _, _, ref_logits_full = forward_seq2seq_policy_and_ref(
+        model, params, ref_full, *args, 0
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits_split), np.asarray(ref_logits_full), atol=1e-4
+    )
+    # and the trunk logits match the torch oracle
+    with torch.no_grad():
+        oracle = hf_model(
+            input_ids=torch.tensor(enc), attention_mask=torch.tensor(enc_mask),
+            decoder_input_ids=torch.tensor(dec),
+            decoder_attention_mask=torch.tensor(dec_mask),
+        ).logits.numpy()
+    np.testing.assert_allclose(
+        np.asarray(ref_logits_full, np.float32), oracle, atol=2e-4, rtol=2e-4
+    )
+
+
 def test_preset_coverage():
     """Every family has at least one preset and they build."""
     from trlx_tpu.models.transformer import PRESETS, config_from_preset

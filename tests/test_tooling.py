@@ -246,10 +246,11 @@ def test_save_pretrained_export_is_self_contained(tmp_path):
             )
 
 
-def test_convert_checkpoint_round_trip(tmp_path):
+@pytest.mark.parametrize("family", ["gpt2", "t5"])
+def test_convert_checkpoint_round_trip(tmp_path, family):
     """examples/convert_checkpoint.py (role of the reference's
     convert_llama_to_nemo.py): HF -> trlx_tpu msgpack -> HF round trip
-    preserves weights."""
+    preserves weights, for causal and seq2seq layouts."""
     import subprocess
     import sys
 
@@ -257,9 +258,17 @@ def test_convert_checkpoint_round_trip(tmp_path):
     import transformers as tf
 
     torch.manual_seed(0)
-    hf = tf.GPT2LMHeadModel(
-        tf.GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2, n_head=2)
-    )
+    if family == "gpt2":
+        hf = tf.GPT2LMHeadModel(
+            tf.GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2, n_head=2)
+        )
+        key = "transformer.h.0.attn.c_attn.weight"
+    else:
+        hf = tf.T5ForConditionalGeneration(
+            tf.T5Config(vocab_size=64, d_model=16, d_kv=8, d_ff=32, num_layers=2,
+                        num_heads=2, decoder_start_token_id=0)
+        )
+        key = "decoder.block.0.layer.1.EncDecAttention.q.weight"
     hf.save_pretrained(str(tmp_path / "src"), safe_serialization=True)
 
     script = os.path.join(os.path.dirname(__file__), "..", "examples", "convert_checkpoint.py")
@@ -278,7 +287,6 @@ def test_convert_checkpoint_round_trip(tmp_path):
 
     sd0 = hf.state_dict()
     sd1 = torch.load(str(tmp_path / "back" / "pytorch_model.bin"), weights_only=True)
-    key = "transformer.h.0.attn.c_attn.weight"
     np.testing.assert_allclose(
         sd0[key].numpy(), sd1[key].float().numpy(), atol=1e-2  # bf16 round trip
     )

@@ -102,7 +102,18 @@ def resolve_transformer_config(model_config, vocab_size: int):
         return config_from_preset(preset, vocab_size=vocab_size, **extra, **dtype_overrides)
     from trlx_tpu.models import hf_interop
 
-    return hf_interop.config_from_hf(path, **extra, **dtype_overrides)
+    cfg = hf_interop.config_from_hf(path, **extra, **dtype_overrides)
+    if is_seq2seq_config(cfg) != seq2seq:
+        # model_arch_type is the single source of truth the trainers
+        # dispatch on (reference configs.py:49-55); a silent promotion
+        # here would desync them.
+        want = "seq2seq" if is_seq2seq_config(cfg) else "causal"
+        raise ValueError(
+            f"Checkpoint at '{path}' is a {want} model but "
+            f"model_arch_type={'seq2seq' if seq2seq else 'causal'!r}; set "
+            f"model_arch_type='{want}' in ModelConfig"
+        )
+    return cfg
 
 
 def build_model(

@@ -11,7 +11,8 @@ checkpoints work.
 
 Supported HF architectures: GPT2LMHeadModel, LlamaForCausalLM,
 GPTNeoXForCausalLM (pythia), GPTJForCausalLM, OPTForCausalLM,
-BloomForCausalLM, GPTBigCodeForCausalLM.
+BloomForCausalLM, GPTBigCodeForCausalLM, and T5ForConditionalGeneration
+(t5 v1.0/v1.1, flan-t5, mt5 -> Seq2SeqConfig/Seq2SeqLM).
 
 Rotary conventions: our kernel uses the half-split ("rotate_half") layout.
 GPT-J checkpoints use the interleaved ("rotate_every_two") layout, so their
@@ -45,6 +46,13 @@ def _read_hf_config(path: str) -> Dict:
 def _family_of(hf: Dict) -> str:
     arch = ((hf.get("architectures") or [""])[0] or "").lower()
     mt = hf.get("model_type", "")
+    # exact matches only: UMT5 (per-layer bias tables) and LongT5
+    # (local/transient-global attention) have different layouts and would
+    # load silently-wrong through the plain-T5 converter
+    if mt in ("t5", "mt5") or arch in (
+        "t5forconditionalgeneration", "mt5forconditionalgeneration"
+    ):
+        return "t5"
     for fam, keys in (
         ("gpt_bigcode", ("bigcode",)),
         ("gpt_neox", ("neox",)),
@@ -64,9 +72,13 @@ def _family_of(hf: Dict) -> str:
 # ---------------------------------------------------------------------------
 
 
-def config_from_hf(path: str, **overrides) -> TransformerConfig:
+def config_from_hf(path: str, **overrides):
+    """Returns a TransformerConfig, or a Seq2SeqConfig for encoder-decoder
+    (t5/mt5/flan-t5) checkpoints — callers dispatch on `cfg.is_seq2seq`."""
     hf = _read_hf_config(path)
     fam = _family_of(hf)
+    if fam == "t5":
+        return _seq2seq_config_from_hf(hf, **overrides)
     if fam == "gpt2":
         kwargs = dict(
             vocab_size=hf["vocab_size"], d_model=hf["n_embd"], n_layers=hf["n_layer"],
@@ -148,6 +160,62 @@ def config_from_hf(path: str, **overrides) -> TransformerConfig:
     kwargs["hf_family"] = fam
     kwargs.update(overrides)
     return TransformerConfig(**kwargs)
+
+
+def _seq2seq_config_from_hf(hf: Dict, **overrides):
+    """HF T5Config -> Seq2SeqConfig. Covers t5 v1.0 (relu MLP, tied
+    embeddings, logits scaled by d_model**-0.5), v1.1/flan-t5 (gated-gelu,
+    untied lm_head, no logit scaling), and mt5 (same as v1.1).
+
+    Parity: the reference wraps these via AutoModelForSeq2SeqLM inside
+    PreTrainedModelWrapper.from_pretrained (trlx/models/modeling_base.py:
+    123-326); HF-T5 numerics are encoded as attention_scale=False (the
+    1/sqrt(d_kv) is folded into init) and the conditional logit_scale."""
+    from trlx_tpu.models.seq2seq import Seq2SeqConfig
+
+    ffp = hf.get("feed_forward_proj", "relu")
+    gated = ffp.startswith("gated-")
+    act = ffp.split("-")[-1]
+    # T5Config forces dense_act_fn='gelu_new' (tanh approx, our "gelu")
+    # ONLY for feed_forward_proj='gated-gelu'; a plain 'gelu' runs HF's
+    # exact erf GELU -> our "gelu_exact". 'gelu_new' appears directly in
+    # some v1.1 configs.
+    act = {
+        "relu": "relu",
+        "gelu": "gelu" if gated else "gelu_exact",
+        "gelu_new": "gelu",
+        "silu": "silu",
+    }[act]
+    tie = bool(hf.get("tie_word_embeddings", True))
+    kwargs = dict(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["d_model"],
+        n_encoder_layers=hf["num_layers"],
+        n_decoder_layers=hf.get("num_decoder_layers") or hf["num_layers"],
+        n_heads=hf["num_heads"],
+        d_kv=hf.get("d_kv"),
+        d_ff=hf["d_ff"],
+        # T5 has no absolute position cap (relative bias saturates); 512 is
+        # the tokenizer's model_max_length convention, override as needed
+        max_seq_len=512,
+        norm="rmsnorm",
+        activation=act,
+        glu=gated,
+        tie_embeddings=tie,
+        use_bias=False,
+        relative_attention=True,
+        relative_attention_num_buckets=hf.get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=hf.get("relative_attention_max_distance", 128),
+        decoder_start_token_id=hf.get("decoder_start_token_id", 0) or 0,
+        pad_token_id=hf.get("pad_token_id", 0),
+        eos_token_id=hf.get("eos_token_id", 1),
+        layer_norm_epsilon=hf.get("layer_norm_epsilon", 1e-6),
+        attention_scale=False,
+        logit_scale=hf["d_model"] ** -0.5 if tie else None,
+        hf_family="t5",
+    )
+    kwargs.update(overrides)
+    return Seq2SeqConfig(**kwargs)
 
 
 # ---------------------------------------------------------------------------
@@ -444,7 +512,71 @@ def _load_gpt_bigcode(sd: Dict, cfg: TransformerConfig) -> Dict:
     return lm
 
 
+def _t5_attn(sd: Dict, p: str) -> Dict:
+    """T5Attention / EncDecAttention ({q,k,v,o}.weight, torch [out, in]) ->
+    our S2SAttention kernels ([in, out])."""
+    return {
+        "q_proj": _dense(sd[p + ".q.weight"].T),
+        "k_proj": _dense(sd[p + ".k.weight"].T),
+        "v_proj": _dense(sd[p + ".v.weight"].T),
+        "o_proj": _dense(sd[p + ".o.weight"].T),
+    }
+
+
+def _t5_mlp(sd: Dict, p: str, glu: bool) -> Dict:
+    if glu:  # v1.1/flan gated act: wi_0 = gate, wi_1 = up
+        return {
+            "gate_proj": _dense(sd[p + ".wi_0.weight"].T),
+            "up_proj": _dense(sd[p + ".wi_1.weight"].T),
+            "down_proj": _dense(sd[p + ".wo.weight"].T),
+        }
+    return {
+        "up_proj": _dense(sd[p + ".wi.weight"].T),
+        "down_proj": _dense(sd[p + ".wo.weight"].T),
+    }
+
+
+def _load_t5(sd: Dict, cfg) -> Dict:
+    """T5ForConditionalGeneration state dict -> our Seq2SeqLM subtree.
+    The per-stack relative-bias table lives in block 0's self-attention
+    (HF computes it there and shares); we store it once per stack
+    (enc_rel_bias / dec_rel_bias), same math."""
+    lm: Dict = {
+        "embed_tokens": {"embedding": sd["shared.weight"]},
+        "enc_ln_f": {"scale": sd["encoder.final_layer_norm.weight"]},
+        "dec_ln_f": {"scale": sd["decoder.final_layer_norm.weight"]},
+        "enc_rel_bias": {"embedding": {"embedding": sd[
+            "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ]}},
+        "dec_rel_bias": {"embedding": {"embedding": sd[
+            "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+        ]}},
+    }
+    for i in range(cfg.n_encoder_layers):
+        p = f"encoder.block.{i}."
+        lm[f"enc_block_{i}"] = {
+            "attn": _t5_attn(sd, p + "layer.0.SelfAttention"),
+            "ln_attn": {"scale": sd[p + "layer.0.layer_norm.weight"]},
+            "mlp": _t5_mlp(sd, p + "layer.1.DenseReluDense", cfg.glu),
+            "ln_mlp": {"scale": sd[p + "layer.1.layer_norm.weight"]},
+        }
+    for i in range(cfg.n_decoder_layers):
+        p = f"decoder.block.{i}."
+        lm[f"dec_block_{i}"] = {
+            "attn": _t5_attn(sd, p + "layer.0.SelfAttention"),
+            "ln_attn": {"scale": sd[p + "layer.0.layer_norm.weight"]},
+            "cross_attn": _t5_attn(sd, p + "layer.1.EncDecAttention"),
+            "ln_cross": {"scale": sd[p + "layer.1.layer_norm.weight"]},
+            "mlp": _t5_mlp(sd, p + "layer.2.DenseReluDense", cfg.glu),
+            "ln_mlp": {"scale": sd[p + "layer.2.layer_norm.weight"]},
+        }
+    if not cfg.tie_embeddings:
+        lm["lm_head"] = _dense(sd["lm_head.weight"].T)
+    return lm
+
+
 _LOADERS: Dict[str, Callable] = {
+    "t5": _load_t5,
     "gpt2": _load_gpt2,
     "llama": _load_llama,
     "gpt_neox": _load_gpt_neox,
@@ -705,7 +837,71 @@ def _export_gpt_bigcode(lm: Dict, cfg: TransformerConfig) -> Dict:
     return sd
 
 
+def _export_t5(lm: Dict, cfg) -> Dict:
+    """Inverse of _load_t5: Seq2SeqLM subtree -> T5ForConditionalGeneration
+    state dict (incl. the per-stack embed_tokens copies HF checkpoints
+    carry)."""
+    def attn(b, name):
+        a = b[name]
+        return {
+            "q.weight": _f32(a["q_proj"]["kernel"]).T,
+            "k.weight": _f32(a["k_proj"]["kernel"]).T,
+            "v.weight": _f32(a["v_proj"]["kernel"]).T,
+            "o.weight": _f32(a["o_proj"]["kernel"]).T,
+        }
+
+    def mlp(b):
+        m = b["mlp"]
+        if cfg.glu:
+            return {
+                "wi_0.weight": _f32(m["gate_proj"]["kernel"]).T,
+                "wi_1.weight": _f32(m["up_proj"]["kernel"]).T,
+                "wo.weight": _f32(m["down_proj"]["kernel"]).T,
+            }
+        return {
+            "wi.weight": _f32(m["up_proj"]["kernel"]).T,
+            "wo.weight": _f32(m["down_proj"]["kernel"]).T,
+        }
+
+    shared = _f32(lm["embed_tokens"]["embedding"])
+    sd = {
+        "shared.weight": shared,
+        "encoder.embed_tokens.weight": shared,
+        "decoder.embed_tokens.weight": shared,
+        "encoder.final_layer_norm.weight": _f32(lm["enc_ln_f"]["scale"]),
+        "decoder.final_layer_norm.weight": _f32(lm["dec_ln_f"]["scale"]),
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight":
+            _f32(lm["enc_rel_bias"]["embedding"]["embedding"]),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight":
+            _f32(lm["dec_rel_bias"]["embedding"]["embedding"]),
+    }
+    for i in range(cfg.n_encoder_layers):
+        b, p = lm[f"enc_block_{i}"], f"encoder.block.{i}."
+        for k, v in attn(b, "attn").items():
+            sd[p + "layer.0.SelfAttention." + k] = v
+        sd[p + "layer.0.layer_norm.weight"] = _f32(b["ln_attn"]["scale"])
+        for k, v in mlp(b).items():
+            sd[p + "layer.1.DenseReluDense." + k] = v
+        sd[p + "layer.1.layer_norm.weight"] = _f32(b["ln_mlp"]["scale"])
+    for i in range(cfg.n_decoder_layers):
+        b, p = lm[f"dec_block_{i}"], f"decoder.block.{i}."
+        for k, v in attn(b, "attn").items():
+            sd[p + "layer.0.SelfAttention." + k] = v
+        sd[p + "layer.0.layer_norm.weight"] = _f32(b["ln_attn"]["scale"])
+        for k, v in attn(b, "cross_attn").items():
+            sd[p + "layer.1.EncDecAttention." + k] = v
+        sd[p + "layer.1.layer_norm.weight"] = _f32(b["ln_cross"]["scale"])
+        for k, v in mlp(b).items():
+            sd[p + "layer.2.DenseReluDense." + k] = v
+        sd[p + "layer.2.layer_norm.weight"] = _f32(b["ln_mlp"]["scale"])
+    sd["lm_head.weight"] = (
+        shared if cfg.tie_embeddings else _f32(lm["lm_head"]["kernel"]).T
+    )
+    return sd
+
+
 _EXPORTERS: Dict[str, Callable] = {
+    "t5": _export_t5,
     "gpt2": _export_gpt2,
     "llama": _export_llama,
     "gpt_neox": _export_gpt_neox,
@@ -716,9 +912,11 @@ _EXPORTERS: Dict[str, Callable] = {
 }
 
 
-def infer_family(cfg: TransformerConfig) -> str:
-    """Best-effort family inference from a TransformerConfig's structure
+def infer_family(cfg) -> str:
+    """Best-effort family inference from a model config's structure
     (used when exporting a model that wasn't loaded from an HF dir)."""
+    if getattr(cfg, "is_seq2seq", False):
+        return "t5"
     if cfg.alibi:
         return "bloom"
     if cfg.pos_offset:
@@ -745,6 +943,40 @@ def config_to_hf(cfg: TransformerConfig, family: str = None) -> Dict:
     self-contained — including models born from `random:` presets with no
     source config.json to copy."""
     family = family or cfg.hf_family or infer_family(cfg)
+    if family == "t5":
+        # inverse of _seq2seq_config_from_hf's activation mapping: HF runs
+        # ACT2FN[dense_act_fn], where 'gelu' is exact-erf and 'gelu_new'
+        # is the tanh approx; 'gated-gelu' forces gelu_new on import so it
+        # round-trips to our "gelu"
+        if cfg.glu:
+            if cfg.activation == "gelu_exact":
+                raise ValueError(
+                    "T5 cannot express a gated exact-erf GELU "
+                    "(gated-gelu always runs gelu_new)"
+                )
+            ffp = {"gelu": "gated-gelu", "silu": "gated-silu",
+                   "relu": "gated-relu"}[cfg.activation]
+        else:
+            ffp = {"relu": "relu", "gelu_exact": "gelu", "silu": "silu",
+                   "gelu": "gelu_new"}[cfg.activation]
+        return dict(
+            model_type="t5", architectures=["T5ForConditionalGeneration"],
+            is_encoder_decoder=True,
+            vocab_size=cfg.vocab_size, d_model=cfg.d_model, d_kv=cfg.head_dim,
+            d_ff=cfg.d_ff, num_layers=cfg.n_encoder_layers,
+            num_decoder_layers=cfg.n_decoder_layers, num_heads=cfg.n_heads,
+            relative_attention_num_buckets=cfg.relative_attention_num_buckets,
+            relative_attention_max_distance=cfg.relative_attention_max_distance,
+            feed_forward_proj=ffp,
+            tie_word_embeddings=cfg.tie_embeddings,
+            layer_norm_epsilon=cfg.layer_norm_epsilon,
+            decoder_start_token_id=cfg.decoder_start_token_id,
+            # preserve the SOURCE tokenizer's ids (recorded at import);
+            # models born from presets fall back to T5 conventions
+            pad_token_id=(cfg.pad_token_id if cfg.pad_token_id is not None
+                          else cfg.decoder_start_token_id),
+            eos_token_id=cfg.eos_token_id if cfg.eos_token_id is not None else 1,
+        )
     if family == "gpt2":
         return dict(
             model_type="gpt2", architectures=["GPT2LMHeadModel"],

@@ -42,6 +42,10 @@ class Seq2SeqConfig:
     n_decoder_layers: int
     n_heads: int
     d_ff: int
+    # T5's per-head width is an independent hyperparameter (HF `d_kv`):
+    # flan-t5-small has d_model=512, 6 heads, d_kv=64 (inner dim 384).
+    # None -> d_model // n_heads.
+    d_kv: Optional[int] = None
     max_seq_len: int = 512
     norm: str = "rmsnorm"
     activation: str = "relu"
@@ -52,11 +56,18 @@ class Seq2SeqConfig:
     relative_attention_num_buckets: int = 32
     relative_attention_max_distance: int = 128
     decoder_start_token_id: int = 0
+    # recorded at HF import so save_pretrained exports preserve the source
+    # tokenizer's special ids (generate() on the reloaded export must stop
+    # and pad on the right tokens); None = T5 defaults (pad 0, eos 1)
+    pad_token_id: Optional[int] = None
+    eos_token_id: Optional[int] = None
     layer_norm_epsilon: float = 1e-6
     # HF-T5 numerics: no 1/sqrt(hd) score scaling, tied logits scaled by
     # d_model**-0.5. From-scratch presets keep standard scaling.
     attention_scale: bool = True
     logit_scale: Optional[float] = None
+    # set by hf_interop when the config came from an HF checkpoint
+    hf_family: Optional[str] = None
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -66,7 +77,7 @@ class Seq2SeqConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.d_kv if self.d_kv is not None else self.d_model // self.n_heads
 
     @property
     def kv_heads(self) -> int:
@@ -622,8 +633,9 @@ SEQ2SEQ_PRESETS: Dict[str, Dict[str, Any]] = {
         max_seq_len=512,
     ),
     "flan-t5-small": dict(
-        d_model=512, n_encoder_layers=8, n_decoder_layers=8, n_heads=6, d_ff=1024,
-        max_seq_len=512, activation="gelu", glu=True, tie_embeddings=False,
+        d_model=512, n_encoder_layers=8, n_decoder_layers=8, n_heads=6, d_kv=64,
+        d_ff=1024, max_seq_len=512, activation="gelu", glu=True,
+        tie_embeddings=False,
     ),
 }
 

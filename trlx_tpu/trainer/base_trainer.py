@@ -959,8 +959,16 @@ class TPUTrainer(BaseRLTrainer):
             # (incl. models born from random: presets)
             from trlx_tpu.models.hf_interop import config_to_hf
 
+            hf_cfg = config_to_hf(self.model_cfg)
+            # stamp the ACTUAL tokenizer's special ids: generate() on the
+            # reloaded export must stop/pad on this run's tokens, not on
+            # the family's defaults
+            for key in ("pad_token_id", "eos_token_id", "bos_token_id"):
+                v = getattr(self.tokenizer, key, None)
+                if v is not None:
+                    hf_cfg[key] = int(v)
             with open(os.path.join(directory, "config.json"), "w") as f:
-                json.dump(config_to_hf(self.model_cfg), f, indent=2)
+                json.dump(hf_cfg, f, indent=2)
             # tokenizer files too, when the tokenizer can express itself in
             # HF format (reference exports carry the tokenizer alongside,
             # accelerate_base_trainer.py:284-307) — the dir then loads in

@@ -1,0 +1,85 @@
+"""Pure-python ROUGE-1/2/L (F-measure), dependency-free.
+
+Parity surface: the reference's published summarize-RLHF quality numbers
+are ROUGE scores computed with HF `evaluate.load("rouge")`
+(/root/reference/examples/summarize_rlhf/trlx_inference_gptj.py:70-135,
+README.md:50-55) — which wraps Google's `rouge_score` package. This module
+reimplements that package's scoring semantics:
+
+- tokenization: lowercase, split on non-alphanumeric runs ([a-z0-9]+),
+  like rouge_score's default tokenizer;
+- rouge1/rouge2: n-gram overlap F1 with clipped counts (each reference
+  n-gram credits at most its reference multiplicity);
+- rougeL: longest-common-subsequence F1 over the token sequences;
+- score = F1 = 2*P*R/(P+R), the `fmeasure` field evaluate reports.
+
+The one deliberate divergence: no Porter stemmer (evaluate defaults to
+use_stemmer=False too, so the default paths match; rouge_score's optional
+stemmer needs nltk, which this environment doesn't ship).
+"""
+
+import re
+from collections import Counter
+from typing import Dict, List, Sequence
+
+_TOKEN_RE = re.compile(r"[a-z0-9]+")
+
+
+def _tokenize(text: str) -> List[str]:
+    return _TOKEN_RE.findall(text.lower())
+
+
+def _ngrams(tokens: Sequence[str], n: int) -> Counter:
+    return Counter(tuple(tokens[i:i + n]) for i in range(len(tokens) - n + 1))
+
+
+def _f1(match: int, n_pred: int, n_ref: int) -> float:
+    if n_pred == 0 or n_ref == 0 or match == 0:
+        return 0.0
+    p, r = match / n_pred, match / n_ref
+    return 2 * p * r / (p + r)
+
+
+def _rouge_n(pred: List[str], ref: List[str], n: int) -> float:
+    pred_counts, ref_counts = _ngrams(pred, n), _ngrams(ref, n)
+    match = sum(min(c, ref_counts[g]) for g, c in pred_counts.items())
+    return _f1(match, sum(pred_counts.values()), sum(ref_counts.values()))
+
+
+def _lcs_len(a: List[str], b: List[str]) -> int:
+    """O(len(a)*len(b)) LCS with a rolling row."""
+    if not a or not b:
+        return 0
+    prev = [0] * (len(b) + 1)
+    for x in a:
+        cur = [0]
+        for j, y in enumerate(b, 1):
+            cur.append(prev[j - 1] + 1 if x == y else max(prev[j], cur[-1]))
+        prev = cur
+    return prev[-1]
+
+
+def rouge_scores(prediction: str, reference: str) -> Dict[str, float]:
+    """{"rouge1","rouge2","rougeL"} F1 for one prediction/reference pair."""
+    pred, ref = _tokenize(prediction), _tokenize(reference)
+    return {
+        "rouge1": _rouge_n(pred, ref, 1),
+        "rouge2": _rouge_n(pred, ref, 2),
+        "rougeL": _f1(_lcs_len(pred, ref), len(pred), len(ref)),
+    }
+
+
+def rouge_metric(predictions: Sequence[str], references: Sequence[str]) -> Dict[str, List[float]]:
+    """Batched per-sample scores, shaped like a trainer metric_fn return
+    (lists align with samples; trackers aggregate to means)."""
+    if len(predictions) != len(references):
+        raise ValueError(
+            f"predictions ({len(predictions)}) and references "
+            f"({len(references)}) must align"
+        )
+    out: Dict[str, List[float]] = {"rouge1": [], "rouge2": [], "rougeL": []}
+    for p, r in zip(predictions, references):
+        s = rouge_scores(p, r)
+        for k in out:
+            out[k].append(s[k])
+    return out

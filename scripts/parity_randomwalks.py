@@ -19,6 +19,11 @@ Stages (all driven by `python scripts/parity_randomwalks.py all`):
   ref-ilql / ours-ilql — same for ILQL (offline method), from the same
                checkpoint, reference example hparams
                (examples/randomwalks/ilql_randomwalks.py:35-62).
+  ref-sft / ours-sft — same for SFT (accelerate_sft_trainer.py:63-73).
+  ref-rft / ours-rft — same for RFT (accelerate_rft_trainer.py:117-197;
+               percentile filtering + dedup, online generations).
+  ref-ppo-dense / ours-ppo-dense — PPO with PER-TOKEN rewards, exercising
+               the dense indexing path (accelerate_ppo_trainer.py:457-492).
   compare    — align curves, write PARITY_CURVES.json at the repo root.
 
 The committed PARITY_CURVES.json is asserted by tests/test_parity_curves.py.
@@ -293,19 +298,14 @@ def cmd_ref_ilql(args):
 
 # ------------------------------------------------------------------ ours
 
-def cmd_ours_ppo(args):
-    sys.path.insert(0, REPO)
-    import trlx_tpu as trlx
+def _ours_ppo_config():
     from trlx_tpu.data.configs import (
         ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
         TokenizerConfig, TrainConfig, TRLConfig,
     )
     from trlx_tpu.trainer.ppo_trainer import PPOConfig
 
-    metric_fn, eval_prompts, _walks = load_reference_task()
-    rec = CurveRecorder(os.path.join(WORKDIR, "ours_ppo.curve.jsonl"), metric_fn)
-
-    config = TRLConfig(
+    return TRLConfig(
         train=TrainConfig(
             seq_length=10, epochs=PPO_EPOCHS_OUTER, total_steps=100000,
             batch_size=100, checkpoint_interval=10**8,
@@ -334,6 +334,15 @@ def cmd_ours_ppo(args):
         ),
         parallel=ParallelConfig(),
     )
+
+
+def cmd_ours_ppo(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_ppo.curve.jsonl"), metric_fn)
+    config = _ours_ppo_config()
     trlx.train(
         reward_fn=rec.reward_fn,
         prompts=sorted(eval_prompts),
@@ -393,6 +402,253 @@ def cmd_ours_ilql(args):
     print(f"[ours-ilql] wrote {rec.path}: {rec.n_eval_calls} evals")
 
 
+# ------------------------------------------------- sft / rft / dense-ppo
+
+SFT_EPOCHS = 16
+SFT_EVAL_INTERVAL = 20
+RFT_EPOCHS = 16
+RFT_EVAL_INTERVAL = 4
+
+# The reference's SFT/RFT rows run with padding_side="right": under its own
+# default (left), the reference TRAINS absolute-position models on
+# arange positions (GPT2 forward ignores the attention mask for
+# position_ids) while its generation uses mask-aware positions — short
+# left-padded sequences land on shifted positions in training and the
+# model degrades from 0.75 to ~0.34 optimality (measured; curve kept at
+# ref_sft_leftpad.curve.jsonl). Our trainers compute mask-aware positions
+# everywhere, so right padding is the setting where the reference's
+# trainer semantics are comparable.
+REF_OFFLINE_PADDING = "right"
+PPO_DENSE_EPOCHS_OUTER = 48
+
+
+def _shared_offline_config(workdir_name, trainer_name, epochs, eval_interval):
+    """Shared SFT/RFT hparams (the reference has no randomwalks example for
+    either; both sides get this identical set)."""
+    return dict(
+        train=dict(
+            seq_length=10, epochs=epochs, total_steps=100000, batch_size=100,
+            checkpoint_interval=10**8, eval_interval=eval_interval,
+            pipeline="PromptPipeline", trainer=trainer_name,
+            checkpoint_dir=os.path.join(WORKDIR, workdir_name),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        optimizer=dict(
+            name="adamw",
+            kwargs=dict(lr=1.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=dict(name="cosine_annealing", kwargs=dict(T_max=1000, eta_min=1.0e-4)),
+        method=dict(gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True)),
+    )
+
+
+def _sft_train_config(workdir_name, trainer_name):
+    return _shared_offline_config(workdir_name, trainer_name,
+                                  SFT_EPOCHS, SFT_EVAL_INTERVAL)
+
+
+def cmd_ref_sft(args):
+    _force_eager_attention()
+    import trlx
+
+    from trlx.data.default_configs import (
+        ModelConfig, OptimizerConfig, SchedulerConfig, SFTConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+
+    metric_fn, eval_prompts, walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ref_sft.curve.jsonl"), metric_fn)
+    c = _sft_train_config("ref_sft_ckpt", "AccelerateSFTTrainer")
+    config = TRLConfig(
+        train=TrainConfig(**c["train"]),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=CKPT, truncation_side="right",
+                                  padding_side=REF_OFFLINE_PADDING),
+        optimizer=OptimizerConfig(**c["optimizer"]),
+        scheduler=SchedulerConfig(**c["scheduler"]),
+        method=SFTConfig(name="sftconfig", **c["method"]),
+    )
+    trlx.train(
+        samples=list(walks),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ref-sft] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+def cmd_ours_sft(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+    from trlx_tpu.data.configs import (
+        ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.trainer.sft_trainer import SFTConfig
+
+    metric_fn, eval_prompts, walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_sft.curve.jsonl"), metric_fn)
+    c = _sft_train_config("ours_sft_ckpt", "SFTTrainer")
+    config = TRLConfig(
+        train=TrainConfig(**c["train"]),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char:{ALPHABET}",
+                                  truncation_side="right"),
+        optimizer=OptimizerConfig(**c["optimizer"]),
+        scheduler=SchedulerConfig(**c["scheduler"]),
+        method=SFTConfig(name="sftconfig", **c["method"]),
+        parallel=ParallelConfig(),
+    )
+    trlx.train(
+        samples=list(walks),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-sft] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+def _rft_method_kwargs():
+    return dict(
+        gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        start_percentile=0.7, end_percentile=0.95,
+        n_improve_steps=4, n_generations_per_prompt=8,
+    )
+
+
+def _rft_config(workdir_name, trainer_name):
+    c = _shared_offline_config(workdir_name, trainer_name,
+                               RFT_EPOCHS, RFT_EVAL_INTERVAL)
+    c["method"] = _rft_method_kwargs()
+    return c
+
+
+def cmd_ref_rft(args):
+    _force_eager_attention()
+    import trlx
+
+    from trlx.data.default_configs import (
+        ModelConfig, OptimizerConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx.trainer.accelerate_rft_trainer import RFTConfig
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ref_rft.curve.jsonl"), metric_fn)
+    c = _rft_config("ref_rft_ckpt", "AccelerateRFTTrainer")
+    config = TRLConfig(
+        train=TrainConfig(**c["train"]),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=CKPT, truncation_side="right",
+                                  padding_side=REF_OFFLINE_PADDING),
+        optimizer=OptimizerConfig(**c["optimizer"]),
+        scheduler=SchedulerConfig(**c["scheduler"]),
+        method=RFTConfig(name="RFTConfig", **c["method"]),
+    )
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ref-rft] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+def cmd_ours_rft(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+    from trlx_tpu.data.configs import (
+        ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.trainer.rft_trainer import RFTConfig
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_rft.curve.jsonl"), metric_fn)
+    c = _rft_config("ours_rft_ckpt", "RFTTrainer")
+    config = TRLConfig(
+        train=TrainConfig(**c["train"]),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char:{ALPHABET}",
+                                  truncation_side="right"),
+        optimizer=OptimizerConfig(**c["optimizer"]),
+        scheduler=SchedulerConfig(**c["scheduler"]),
+        method=RFTConfig(name="RFTConfig", **c["method"]),
+        parallel=ParallelConfig(),
+    )
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-rft] wrote {rec.path}: {rec.n_eval_calls} evals")
+
+
+class DenseCurveRecorder(CurveRecorder):
+    """Per-TOKEN rewards: the sample's optimality spread over its response
+    tokens on a decreasing ramp w_i = 2(n-i)/(n(n+1)) (sum 1) — position-
+    sensitive, so any off-by-one in either framework's dense indexing
+    (reference accelerate_ppo_trainer.py:457-492, SURVEY §7 "hard parts")
+    shifts the learned behavior and shows in the curve. The curve logs the
+    per-sample TOTAL (= optimality), so rows read like the scalar runs'."""
+
+    def reward_fn(self, samples, prompts=None, outputs=None, **kwargs):
+        # parent logs the scalar curve row (identical bookkeeping to the
+        # scalar runs); the dense shape is derived from its return
+        scores = super().reward_fn(samples, **kwargs)
+        dense = []
+        for opt, out in zip((float(s) for s in scores), outputs):
+            n = max(len(out), 1)  # char tokenizer: 1 char = 1 token
+            w = [2.0 * (n - i) / (n * (n + 1)) for i in range(n)]
+            dense.append([opt * wi for wi in w])
+        return dense
+
+
+def cmd_ref_ppo_dense(args):
+    _force_eager_attention()
+    import trlx
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = DenseCurveRecorder(os.path.join(WORKDIR, "ref_ppo_dense.curve.jsonl"), metric_fn)
+    config = _reference_ppo_config(trlx)
+    config.train.epochs = PPO_DENSE_EPOCHS_OUTER
+    config.train.checkpoint_dir = os.path.join(WORKDIR, "ref_ppo_dense_ckpt")
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ref-ppo-dense] wrote {rec.path}: {rec.n_eval_calls} evals, "
+          f"{rec.n_reward_calls} reward calls")
+
+
+def cmd_ours_ppo_dense(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = DenseCurveRecorder(os.path.join(WORKDIR, "ours_ppo_dense.curve.jsonl"), metric_fn)
+    config = _ours_ppo_config()
+    config = config.evolve(train=dict(
+        epochs=PPO_DENSE_EPOCHS_OUTER,
+        checkpoint_dir=os.path.join(WORKDIR, "ours_ppo_dense_ckpt"),
+    ))
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-ppo-dense] wrote {rec.path}: {rec.n_eval_calls} evals, "
+          f"{rec.n_reward_calls} reward calls")
+
+
 # --------------------------------------------------------------- compare
 
 def _load_curve(path):
@@ -428,6 +684,14 @@ def cmd_compare(args):
                    f"epochs={PPO_EPOCHS_OUTER}, eval_interval={PPO_EVAL_INTERVAL}",
             "ilql": "reference examples/randomwalks/ilql_randomwalks.py hparams, "
                     f"epochs={ILQL_EPOCHS}, eval_interval={ILQL_EVAL_INTERVAL}, beta=[1]",
+            "sft": f"shared hparams (no reference randomwalks SFT example): lr 1e-4, "
+                   f"epochs={SFT_EPOCHS}, eval_interval={SFT_EVAL_INTERVAL}",
+            "rft": f"reference RFTConfig defaults except n_generations_per_prompt=8; "
+                   f"lr 1e-4, epochs={RFT_EPOCHS}, eval_interval={RFT_EVAL_INTERVAL}",
+            "ppo_dense": "ppo hparams with PER-TOKEN rewards (decreasing ramp "
+                         "summing to optimality; exercises the dense indexing of "
+                         "reference accelerate_ppo_trainer.py:457-492), "
+                         f"epochs={PPO_DENSE_EPOCHS_OUTER}",
         },
         "notes": [
             "Both sides load the same LM checkpoint; value/Q heads are "
@@ -445,26 +709,40 @@ def cmd_compare(args):
         "methods": {},
     }
     ok = True
-    for method in ("ppo", "ilql"):
+    ref_trainer = {
+        "ppo": "AcceleratePPOTrainer", "ilql": "AccelerateILQLTrainer",
+        "sft": "AccelerateSFTTrainer", "rft": "AccelerateRFTTrainer",
+        "ppo_dense": "AcceleratePPOTrainer (dense rewards)",
+    }
+    ours_trainer = {
+        "ppo": "PPOTrainer", "ilql": "ILQLTrainer", "sft": "SFTTrainer",
+        "rft": "RFTTrainer", "ppo_dense": "PPOTrainer (dense rewards)",
+    }
+    for method in ("ppo", "ilql", "sft", "rft", "ppo_dense"):
         ref_path = os.path.join(WORKDIR, f"ref_{method}.curve.jsonl")
         ours_path = os.path.join(WORKDIR, f"ours_{method}.curve.jsonl")
         if not (os.path.exists(ref_path) and os.path.exists(ours_path)):
-            # refuse rather than clobber the committed artifact with an
-            # empty comparison
-            raise SystemExit(
-                f"[compare] missing curves for {method} "
-                f"({ref_path} / {ours_path}); run the training stages first"
-            )
+            if method in ("ppo", "ilql"):
+                # the core rows: refuse rather than clobber the committed
+                # artifact with an empty comparison
+                raise SystemExit(
+                    f"[compare] missing curves for {method} "
+                    f"({ref_path} / {ours_path}); run the training stages first"
+                )
+            # aux rows (sft/rft/ppo_dense) may be absent on a partial
+            # workdir (e.g. `all --only ref-ppo ours-ppo`): skip, loudly
+            print(f"[compare] skipping {method}: curves not present")
+            continue
         ref_evals, ref_rewards = _load_curve(ref_path)
         ours_evals, ours_rewards = _load_curve(ours_path)
         rs, os_ = _summary(ref_evals), _summary(ours_evals)
         entry = {
-            "reference": {"trainer": f"Accelerate{method.upper()}Trainer",
+            "reference": {"trainer": ref_trainer[method],
                           "eval_curve": [round(v, 4) for v in ref_evals],
                           "reward_curve": [[n, round(v, 4)] for n, v in ref_rewards],
                           **{k: round(v, 4) if isinstance(v, float) else v
                              for k, v in rs.items()}},
-            "ours": {"trainer": f"{method.upper()}Trainer",
+            "ours": {"trainer": ours_trainer[method],
                      "eval_curve": [round(v, 4) for v in ours_evals],
                      "reward_curve": [[n, round(v, 4)] for n, v in ours_rewards],
                      **{k: round(v, 4) if isinstance(v, float) else v
@@ -525,6 +803,9 @@ def cmd_all(args):
     for stage, env in (
         ("ref-ppo", ref_env), ("ours-ppo", ours_env),
         ("ref-ilql", ref_env), ("ours-ilql", ours_env),
+        ("ref-sft", ref_env), ("ours-sft", ours_env),
+        ("ref-rft", ref_env), ("ours-rft", ours_env),
+        ("ref-ppo-dense", ref_env), ("ours-ppo-dense", ours_env),
     ):
         if args.only and stage not in args.only:
             continue
@@ -536,6 +817,8 @@ def main():
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("stage", choices=[
         "prepare", "ref-ppo", "ours-ppo", "ref-ilql", "ours-ilql",
+        "ref-sft", "ours-sft", "ref-rft", "ours-rft",
+        "ref-ppo-dense", "ours-ppo-dense",
         "compare", "all",
     ])
     parser.add_argument("--warm-steps", type=int, default=100)
@@ -547,6 +830,9 @@ def main():
     cmd = {
         "prepare": cmd_prepare, "ref-ppo": cmd_ref_ppo, "ours-ppo": cmd_ours_ppo,
         "ref-ilql": cmd_ref_ilql, "ours-ilql": cmd_ours_ilql,
+        "ref-sft": cmd_ref_sft, "ours-sft": cmd_ours_sft,
+        "ref-rft": cmd_ref_rft, "ours-rft": cmd_ours_rft,
+        "ref-ppo-dense": cmd_ref_ppo_dense, "ours-ppo-dense": cmd_ours_ppo_dense,
         "compare": cmd_compare, "all": cmd_all,
     }[args.stage]
     rc = cmd(args)

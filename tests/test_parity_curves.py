@@ -36,17 +36,24 @@ def artifact():
         return json.load(f)
 
 
-@pytest.mark.parametrize("method", ["ppo", "ilql"])
+METHODS = ["ppo", "ilql", "sft", "rft", "ppo_dense"]
+
+# sft/rft run fewer, coarser evals (cheap offline methods); the online PPO
+# variants log every eval_interval over 48-64 epochs
+MIN_POINTS = {"ppo": 12, "ilql": 12, "sft": 6, "rft": 3, "ppo_dense": 12}
+
+
+@pytest.mark.parametrize("method", METHODS)
 def test_method_present_with_full_curves(artifact, method):
     entry = artifact["methods"][method]
     # both sides actually trained: full eval curves, sensible point counts
-    assert entry["reference"]["n_points"] >= 12
-    assert entry["ours"]["n_points"] >= 12
+    assert entry["reference"]["n_points"] >= MIN_POINTS[method]
+    assert entry["ours"]["n_points"] >= MIN_POINTS[method]
     assert len(entry["reference"]["eval_curve"]) == entry["reference"]["n_points"]
     assert len(entry["ours"]["eval_curve"]) == entry["ours"]["n_points"]
 
 
-@pytest.mark.parametrize("method", ["ppo", "ilql"])
+@pytest.mark.parametrize("method", METHODS)
 def test_ours_matches_or_beats_reference(artifact, method):
     entry = artifact["methods"][method]
     delta = entry["delta_mean_last_quarter"]

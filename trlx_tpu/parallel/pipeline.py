@@ -163,7 +163,8 @@ def stack_block_params(params: Dict, n_layers: int, n_stages: int) -> Tuple[Dict
 
 
 def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
-                       attn_mask, layer_offset=0, freeze_split: int = 0):
+                       attn_mask, layer_offset=0, freeze_split: int = 0,
+                       collect_aux: bool = False):
     """Sequentially apply this stage's layers via lax.scan over the stacked
     param dim (static per-layer graph, compiled once).
 
@@ -172,13 +173,28 @@ def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
     modeling_nemo_ppo.py:497-536): each frozen layer's output passes
     through `stop_gradient`, so no cotangent reaches its params or
     anything below it. `layer_offset` (static or traced — the stage/chunk
-    index is an axis_index) maps the scan slot to the global layer."""
+    index is an axis_index) maps the scan slot to the global layer.
+
+    `collect_aux` additionally returns the sum of the layers' MoE
+    load-balancing scalars (sown via flax intermediates, which cannot
+    cross the enclosing shard_map on their own — the GSPMD trainers'
+    mutable=["intermediates"] route stops at the manual-mesh boundary)."""
     block = Block(cfg)
     n_local = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
 
-    def fwd(lp, h):
-        h_out, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
-        return h_out
+    if collect_aux:
+        from trlx_tpu.models.transformer import moe_aux_from_intermediates
+
+        def fwd(lp, h):
+            (h_out, _), inter = block.apply(
+                {"params": lp}, h, bias, positions, attn_mask=attn_mask,
+                mutable=["intermediates"],
+            )
+            return h_out, moe_aux_from_intermediates(inter).astype(jnp.float32)
+    else:
+        def fwd(lp, h):
+            h_out, _ = block.apply({"params": lp}, h, bias, positions, attn_mask=attn_mask)
+            return h_out, jnp.float32(0)
 
     if cfg.remat_blocks:
         # backward recomputes each layer's internals instead of banking
@@ -187,9 +203,10 @@ def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
         # are unnecessary (jax.checkpoint docs) and cost on the hot path.
         fwd = jax.checkpoint(fwd, prevent_cse=False)
 
-    def body(h, xs):
+    def body(carry, xs):
+        h, aux = carry
         lp, i = xs
-        h_out = fwd(lp, h)
+        h_out, layer_aux = fwd(lp, h)
         if freeze_split > 0:
             frozen = (layer_offset + i) < freeze_split
             # value-level select: d/dh is scaled by the 0/1 indicator, so
@@ -197,10 +214,15 @@ def _apply_layer_stack(cfg: TransformerConfig, layer_params, h, bias, positions,
             # below them; the update mask (pipelined_mixin) additionally
             # shields them from optimizer side effects like weight decay
             h_out = jnp.where(frozen, jax.lax.stop_gradient(h_out), h_out)
-        return h_out, None
+        return (h_out, aux + layer_aux), None
 
-    h, _ = jax.lax.scan(body, h, (layer_params, jnp.arange(n_local)))
-    return h
+    # the aux carry must share h's varying-manual-axes type (VMA): a plain
+    # scalar literal is unvarying and the scan carry type check rejects it
+    aux0 = jnp.zeros_like(h[(0,) * h.ndim], dtype=jnp.float32)
+    (h, aux), _ = jax.lax.scan(
+        body, (h, aux0), (layer_params, jnp.arange(n_local))
+    )
+    return (h, aux) if collect_aux else h
 
 
 def gpipe_blocks(
@@ -212,10 +234,15 @@ def gpipe_blocks(
     positions: Optional[jnp.ndarray] = None,  # [B, t] GLOBAL position ids
     axis_name: str = PIPE_AXIS,
     freeze_split: int = 0,
+    with_aux: bool = False,
 ) -> jnp.ndarray:
     """Run the block stack as a GPipe pipeline. Must be called inside
     shard_map with `axis_name` bound. Returns [B, t, d] (valid on every
-    stage — the final activations are broadcast from the last stage).
+    stage — the final activations are broadcast from the last stage);
+    with `with_aux`, also the MoE load-balancing scalar summed over ALL
+    stages' layers and averaged over microbatches (the microbatch mean
+    matches the GSPMD trainers' one-forward-over-the-batch semantics up
+    to routing statistics granularity).
 
     `positions` carries GLOBAL position ids computed before the shard_map
     (a local cumsum would restart at 0 on every sequence shard and is not
@@ -244,12 +271,13 @@ def gpipe_blocks(
         return _apply_layer_stack(
             cfg, my_layers, x, bias, pos, mask,
             layer_offset=idx * lps, freeze_split=freeze_split,
+            collect_aux=with_aux,
         )
 
     fwd_perm = [(s, s + 1) for s in range(S - 1)]  # no wraparound
 
     def tick(carry, r):
-        recv_h, recv_mask, recv_pos = carry
+        recv_h, recv_mask, recv_pos, aux_acc = carry
         r_in = jnp.clip(r, 0, M - 1)
         mb_h = jax.lax.dynamic_index_in_dim(h_mbs, r_in, 0, keepdims=False)
         mb_mask = jax.lax.dynamic_index_in_dim(mask_mbs, r_in, 0, keepdims=False)
@@ -257,7 +285,14 @@ def gpipe_blocks(
         x = jnp.where(idx == 0, mb_h, recv_h)
         mask = jnp.where(idx == 0, mb_mask, recv_mask)
         pos = jnp.where(idx == 0, mb_pos, recv_pos)
-        y = stage(x, mask, pos)
+        if with_aux:
+            y, aux = stage(x, mask, pos)
+            # only ticks doing REAL microbatch work contribute (stage idx
+            # processes microbatch r - idx; ramp/drain slots run garbage)
+            valid = (r >= idx) & (r < idx + M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        else:
+            y = stage(x, mask, pos)
 
         next_h, next_mask, next_pos = jax.lax.ppermute(
             (y, mask, pos), axis_name, fwd_perm
@@ -266,21 +301,31 @@ def gpipe_blocks(
         # is saved by the scan's backward at EVERY tick — O(M^2)
         # activation residuals — while ys are written once, keeping the
         # bank O(M) (tests/test_pipeline_memory.py pins the bound)
-        return (next_h, next_mask, next_pos), y
+        return (next_h, next_mask, next_pos, aux_acc), y
 
     init = jax.tree_util.tree_map(
         lambda x: _varying(x, axis_name),
         (jnp.zeros_like(h_mbs[0]), jnp.zeros_like(mask_mbs[0]),
-         jnp.zeros_like(pos_mbs[0])),
+         jnp.zeros_like(pos_mbs[0]),
+         # zeros_like inherits h's varying-axes type; a scalar literal
+         # would trip the scan carry VMA check once the stage aux (which
+         # varies over data/pipe/sequence) accumulates into it
+         jnp.zeros_like(h_mbs[0, 0, 0, 0], dtype=jnp.float32)),
     )
-    _, ys = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
+    (_, _, _, aux_acc), ys = jax.lax.scan(tick, init, jnp.arange(M + S - 1))
 
     # Microbatch m finishes on the LAST stage at tick m + S - 1; broadcast
     # those activations to all stages (mask-and-psum; one collective, lets
     # unembed/loss run replicated).
     out = ys[S - 1:]
     out = jax.lax.psum(jnp.where(idx == S - 1, out, jnp.zeros_like(out)), axis_name)
-    return out.reshape(B, t, d)
+    out = out.reshape(B, t, d)
+    if with_aux:
+        # total over stages (each stage summed only its own layers), mean
+        # over microbatches
+        aux_total = jax.lax.psum(aux_acc, axis_name) / M
+        return out, aux_total
+    return out
 
 
 def stack_block_params_interleaved(
@@ -435,6 +480,7 @@ def make_gpipe_forward_stacked(
     with_hidden: bool = False,
     n_virtual: int = 1,
     freeze_split: int = 0,
+    with_aux: bool = False,
 ) -> Callable:
     """Build fn(stacked, rest, tokens, attn_mask) -> logits (or
     (logits, h_final) with with_hidden) where `stacked` is the
@@ -442,7 +488,16 @@ def make_gpipe_forward_stacked(
     — the layout the pipelined trainer keeps params in permanently, so no
     per-call restacking. With n_virtual > 1 `stacked` is the interleaved
     [n_stages, n_virtual, lps, ...] layout and the interleaved schedule
-    runs instead of GPipe."""
+    runs instead of GPipe. `with_aux` (GPipe only) appends the MoE
+    load-balancing scalar to the outputs — the in-pipe route for the aux
+    loss the GSPMD trainers read from flax intermediates (which cannot
+    cross the shard_map)."""
+    if with_aux and n_virtual > 1:
+        raise NotImplementedError(
+            "MoE aux collection is not wired through the interleaved "
+            "schedule (chunk ticks would need per-chunk validity gating); "
+            "use pipeline_interleave=1 with MoE"
+        )
 
     def embed(rest_params, tokens, positions):
         return model.apply({"params": {**rest_params}}, tokens, positions, method=model.embed)
@@ -452,15 +507,32 @@ def make_gpipe_forward_stacked(
 
     def inner(stacked, rest, tokens, attn_mask, positions):
         h = embed(rest, tokens, positions)
+        aux = None
         if n_virtual > 1:
             h = interleaved_blocks(cfg, stacked, h, attn_mask, n_microbatches,
                                    n_virtual, positions=positions,
                                    freeze_split=freeze_split)
         else:
             h = gpipe_blocks(cfg, stacked, h, attn_mask, n_microbatches,
-                             positions=positions, freeze_split=freeze_split)
+                             positions=positions, freeze_split=freeze_split,
+                             with_aux=with_aux)
+            if with_aux:
+                h, aux = h
         logits, h_final = unembed(rest, h)
-        return (logits, h_final) if with_hidden else logits
+        out = (logits, h_final) if with_hidden else (logits,)
+        if with_aux:
+            # mean over the manual batch axes so the scalar is genuinely
+            # replicated (its out_spec is P()): each data slice (and, under
+            # PP x SP, each sequence shard) ran its own microbatches, so
+            # this is the full-batch average — the same reduction the data
+            # axis applies to the CE loss via the grad psum
+            batch_axes = tuple(
+                ax for ax in ("data", "sequence") if ax in mesh.axis_names
+            )
+            for ax in batch_axes:
+                aux = jax.lax.pmean(aux, ax)
+            out = out + (aux,)
+        return out[0] if len(out) == 1 else out
 
     # Batch sharded over the mesh's "data" axis (DP x PP hybrid: each
     # data slice runs its own pipeline over the shared stage params);
@@ -475,6 +547,12 @@ def make_gpipe_forward_stacked(
     has_seq = "sequence" in mesh.axis_names
     b_spec = P("data", "sequence") if has_seq else P("data")
     out_spec = (b_spec, b_spec) if with_hidden else b_spec
+    if with_aux:
+        # the aux scalar is psum'd over pipe inside and identical across
+        # data slices only after their mean — keep it per-data-slice
+        # varying? No: P() replicates; shard_map will average-check.
+        aux_spec = P()
+        out_spec = (out_spec if isinstance(out_spec, tuple) else (out_spec,)) + (aux_spec,)
     smap = partial_shard_map(
         inner,
         mesh,

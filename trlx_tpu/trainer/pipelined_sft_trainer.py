@@ -41,6 +41,7 @@ logger = logging.get_logger(__name__)
 class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
     _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
     _1f1b_supports_sequence = True  # CE targets preshift globally
+    _supports_moe_pp = True  # in-pipe aux-loss carry consumed below
 
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)
@@ -61,13 +62,26 @@ class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
         return causal_ce_1f1b_parts(model)
 
     def make_loss_fn(self) -> Callable:
-        fwd = self.make_stacked_lm_forward()
+        moe = getattr(self.model_cfg, "moe_experts", 0) > 0
+        moe_coef = getattr(self.model_cfg, "moe_aux_coef", 0.0)
+        fwd = self.make_stacked_lm_forward(with_aux=moe)
 
         def loss_fn(train_params, frozen_params, batch):
             params = merge_params(train_params, frozen_params)
             input_ids = batch["input_ids"]
             attention_mask = batch["attention_mask"]
-            logits = fwd(params["lm_stacked"], params["lm_rest"], input_ids, attention_mask)
+            out = fwd(params["lm_stacked"], params["lm_rest"], input_ids, attention_mask)
+            if moe:
+                logits, moe_aux = out
+                loss, stats = causal_lm_ce_loss(
+                    logits, input_ids, attention_mask, batch.get("labels")
+                )
+                # same scaling as the GSPMD SFT trainer's intermediates
+                # route (sft_trainer.py), just carried through the pipe
+                aux = moe_coef * moe_aux
+                return loss + aux, {**stats, "moe_aux_loss": aux,
+                                    "loss": loss + aux}
+            logits = out
             return causal_lm_ce_loss(logits, input_ids, attention_mask, batch.get("labels"))
 
         return loss_fn

@@ -21,6 +21,7 @@ Prints ONE JSON line per sequence length:
 """
 
 import json
+import os
 import sys
 import time
 
@@ -29,7 +30,8 @@ import numpy as np
 from bench import chip_peak_flops
 
 
-def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False):
+def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False,
+        attn_impl: str = "flash"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -42,7 +44,7 @@ def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False):
     vocab = 1024 if smoke else 50257
     cfg = config_from_preset(
         preset, vocab_size=vocab, max_seq_len=seq_len,
-        attn_impl="flash", remat_blocks=True,
+        attn_impl=attn_impl, remat_blocks=True,
     )
     model = TransformerLM(cfg)
 
@@ -96,6 +98,7 @@ def run(seq_len: int, batch: int, n_steps: int = 5, smoke: bool = False):
         "metric": "longctx_train_tokens_per_sec_per_chip",
         "seq_len": seq_len,
         "batch": batch,
+        "attn_impl": attn_impl,
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": None,
@@ -114,7 +117,9 @@ def main():
 
     try:  # persistent XLA compile cache (same dir as bench.py): the 8k/16k
         # flash fwd+bwd graphs take minutes to compile cold, seconds warm
-        jax.config.update("jax_compilation_cache_dir", "/tmp/trlx_tpu_xla_cache")
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("TRLX_TPU_XLA_CACHE",
+                                         "/tmp/trlx_tpu_xla_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
@@ -123,9 +128,20 @@ def main():
     if smoke:
         run(512, 2, n_steps=2, smoke=True)
         return
-    run(8192, 4)
+    impl = "flash"
+    if "--impl" in sys.argv:
+        # e.g. "blockwise" (pure-XLA scan flash): compiles fast but its
+        # scan backward banks the O(t) carry per kv block, so it only fits
+        # HBM at moderate sequence lengths — useful for comparisons, NOT
+        # as an 8k cold-cache fallback (measured: 49G needed at 8k/b4)
+        impl = sys.argv[sys.argv.index("--impl") + 1]
+    if "--seq" in sys.argv:  # single-length mode
+        seq = int(sys.argv[sys.argv.index("--seq") + 1])
+        run(seq, max(2, 32768 // seq), attn_impl=impl)
+        return
+    run(8192, 4, attn_impl=impl)
     if "--8k-only" not in sys.argv:
-        run(16384, 2)
+        run(16384, 2, attn_impl=impl)
 
 
 if __name__ == "__main__":

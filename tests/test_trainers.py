@@ -347,3 +347,40 @@ def test_ppo_value_branch_full_loop(tmp_path):
     )
     assert trainer.iter_count == 2
     assert any("value_branch" in str(k) for k in trainer.train_params)
+
+
+def test_ppo_windowed_loss_equals_full_forward(tmp_path):
+    """The r5 windowed-head train loss (forward_window: trunk full-width,
+    50k-vocab unembed + CE + value head over the response window only)
+    must produce the SAME loss and stats as the full-forward + slice
+    path on identical params and chunk — the windowing is a pure
+    dead-compute elimination, never a numerics change."""
+    import jax
+    import jax.numpy as jnp
+
+    config = ppo_config(tmp_path)
+    config = config.evolve(model=dict(model_extra_configs=dict(dtype="float32")))
+    trainer = trlx.train(
+        reward_fn=count_letters_reward,
+        prompts=["ab", "cd", "ef", "gh"] * 2,
+        eval_prompts=["ab", "cd"],
+        config=config.evolve(train=dict(total_steps=1, eval_interval=100)),
+    )
+    assert trainer._window_loss_ok()
+    loss_windowed = trainer.make_loss_fn()
+
+    # force the full-forward path on the same trainer
+    trainer._window_loss_ok = lambda: False
+    loss_full = trainer.make_loss_fn()
+
+    loader = trainer.store.create_loader(8, shuffle=False)
+    chunk = jax.tree_util.tree_map(jnp.asarray, next(iter(loader)))
+    lw, sw = loss_windowed(trainer.train_params, trainer.frozen_params, chunk)
+    lf, sf = loss_full(trainer.train_params, trainer.frozen_params, chunk)
+    np.testing.assert_allclose(np.asarray(lw), np.asarray(lf), rtol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        sw, sf,
+    )

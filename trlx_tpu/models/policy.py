@@ -94,6 +94,25 @@ class CausalLMWithValueHead(nn.Module):
         values = self.v_head(h_final)[..., 0]
         return logits, values, h_split
 
+    def forward_window(self, tokens, attn_mask, positions=None,
+                       start: int = 0, length: int = 1):
+        """(logits_win, values_win) over positions [start, start+length)
+        only — exactly the slice the PPO train loss consumes (the
+        full-width 50k-vocab unembed was the cycle's largest wasted
+        matmul; TransformerLM.forward_window). The MLP value head reads
+        per-position hidden states, so windowing it is exact; the deeper
+        value BRANCH runs attention over the full sequence and cannot be
+        windowed."""
+        if self.num_value_layers > 0:
+            raise NotImplementedError(
+                "forward_window with a value branch is unsupported (branch "
+                "blocks attend over the full sequence)"
+            )
+        logits, h_final = self.lm.forward_window(
+            tokens, attn_mask, positions, start, length
+        )
+        return logits, self.v_head(h_final)[..., 0]
+
     def forward_ref_suffix(self, h_split, attn_mask, positions=None, start_layer: int = 0):
         """Frozen-branch pass from the split point (apply with ref params)."""
         return self.lm.forward_from(h_split, attn_mask, positions, start_layer)

@@ -134,6 +134,16 @@ class PPOTrainer(TPUTrainer):
     # Loss
     # ------------------------------------------------------------------
 
+    def _window_loss_ok(self) -> bool:
+        """Whether the train loss can use the windowed head
+        (forward_window): needs the plain MLP value head and no soft
+        prompt (the branch attends full-width; the prompt shifts
+        positions)."""
+        return (
+            getattr(self.config.method, "num_value_layers_unfrozen", 0) == 0
+            and getattr(self.model_cfg, "prompt_tokens", 0) == 0
+        )
+
     def make_loss_fn(self) -> Callable:
         model = self.model
         method = self.config.method
@@ -204,28 +214,45 @@ class PPOTrainer(TPUTrainer):
             tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
             attention_mask = (tokens != pad_id).astype(jnp.int32)
             positions = position_ids(attention_mask)
+            start = query_tensors.shape[1] - 1
+            end = start + response_length
+
+            def window_from_full(logits, values_full):
+                lp = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
+                return lp[:, start:end], values_full[:, :-1][:, start:end]
+
             moe_aux = 0.0
             if getattr(self.model_cfg, "moe_experts", 0) > 0:
                 from trlx_tpu.models.transformer import moe_aux_from_intermediates
 
-                (logits, values_pred, _), inter = model.apply(
+                (logits, values_full, _), inter = model.apply(
                     {"params": params}, tokens, attention_mask, positions,
                     mutable=["intermediates"],
                 )
                 moe_aux = getattr(self.model_cfg, "moe_aux_coef", 0.0) * (
                     moe_aux_from_intermediates(inter)
                 )
+                logprobs, values_pred = window_from_full(logits, values_full)
+            elif self._window_loss_ok():
+                # window the head (r5): trunk runs full-width, the
+                # 50k-vocab unembed + fused CE + value head run over the
+                # response window only — the loss reads exactly this
+                # slice, and the full-width head was the cycle's largest
+                # wasted matmul (tests/test_trainers.py pins equality with
+                # the full-forward loss)
+                logits_w, values_pred = model.apply(
+                    {"params": params}, tokens, attention_mask, positions,
+                    start, response_length,
+                    method=type(model).forward_window,
+                )
+                logprobs = logprobs_of_labels(
+                    logits_w, tokens[:, start + 1:end + 1]
+                )
             else:
-                logits, values_pred, _ = model.apply(
+                logits, values_full, _ = model.apply(
                     {"params": params}, tokens, attention_mask, positions
                 )
-            values_pred = values_pred[:, :-1]
-            logprobs = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
-
-            start = query_tensors.shape[1] - 1
-            end = start + response_length
-            logprobs = logprobs[:, start:end]
-            values_pred = values_pred[:, start:end]
+                logprobs, values_pred = window_from_full(logits, values_full)
             mask = attention_mask[:, start + 1 : end + 1]
 
             loss, stats = ppo_loss(

@@ -290,3 +290,50 @@ def test_ppo_seq2seq_from_hf_checkpoint(tmp_path):
         jnp.asarray(dec, jnp.int32), jnp.ones((1, 3), jnp.int32), 0,
     )
     np.testing.assert_allclose(np.asarray(logits, np.float32), ref, atol=2e-3, rtol=2e-3)
+
+
+def test_ilql_seq2seq_from_hf_checkpoint(tmp_path):
+    """ILQL's seq2seq path also loads real T5 checkpoints through the t5
+    interop (the reference's AutoModelForSeq2SeqLMWithILQLHeads wraps
+    from_pretrained the same way, modeling_ilql.py:481-667)."""
+    torch = pytest.importorskip("torch")
+    import transformers as tf
+
+    hf_cfg = tf.T5Config(
+        vocab_size=320, d_model=32, d_kv=16, d_ff=64, num_layers=2,
+        num_decoder_layers=2, num_heads=4, decoder_start_token_id=0,
+        feed_forward_proj="relu", tie_word_embeddings=True,
+    )
+    torch.manual_seed(0)
+    tf.T5ForConditionalGeneration(hf_cfg).save_pretrained(
+        str(tmp_path / "t5"), safe_serialization=True
+    )
+
+    config = TRLConfig(
+        train=TrainConfig(
+            seq_length=24, epochs=2, total_steps=2, batch_size=4,
+            checkpoint_interval=100, eval_interval=4, pipeline="PromptPipeline",
+            trainer="ILQLTrainer", tracker=None,
+            checkpoint_dir=str(tmp_path / "ckpts"),
+        ),
+        model=ModelConfig(
+            model_path=str(tmp_path / "t5"),
+            model_arch_type="seq2seq",
+            model_extra_configs=dict(decoder_start_token_id=256, dtype="float32"),
+        ),
+        tokenizer=TokenizerConfig(tokenizer_path="byte"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="constant"),
+        method=ILQLConfig(
+            name="ilqlconfig", tau=0.7, gamma=0.99, cql_scale=0.1, awac_scale=1.0,
+            alpha=1.0, beta=0.0, steps_for_target_q_sync=2, two_qs=True,
+            gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0, temperature=1.0),
+        ),
+    )
+    trainer = trlx.train(
+        samples=[("ask", " yes"), ("ask", " no"), ("q", " maybe"), ("q", " sure")],
+        rewards=[1.0, -1.0, 0.5, 0.2],
+        eval_prompts=["ask", "q"],
+        config=config,
+    )
+    assert trainer.iter_count >= 1 and trainer.seq2seq

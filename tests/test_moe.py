@@ -203,11 +203,10 @@ def test_moe_pipeline_parallel_training(tmp_path):
     assert trainer2.iter_count >= 1
 
 
-def test_moe_pp_refusals_still_guard_unwired_paths():
-    """1F1B / interleave / non-SFT pipelined trainers still refuse MoE
-    loudly (the aux channel is only wired through the GPipe program)."""
-    from trlx_tpu.data.default_configs import default_ppo_config, default_sft_config
-    from trlx_tpu.trainer.pipelined_ppo_trainer import PipelinedPPOTrainer
+def test_moe_pp_refusals_still_guard_unwired_schedules():
+    """1F1B / interleave still refuse MoE loudly (the aux channel is only
+    wired through the GPipe program)."""
+    from trlx_tpu.data.default_configs import default_sft_config
     from trlx_tpu.trainer.pipelined_sft_trainer import PipelinedSFTTrainer
 
     base = default_sft_config().evolve(
@@ -223,14 +222,117 @@ def test_moe_pp_refusals_still_guard_unwired_paths():
     with pytest.raises(NotImplementedError, match="interleave"):
         PipelinedSFTTrainer(base.evolve(
             parallel=dict(data=2, pipeline=2, pipeline_interleave=2)))
-    ppo = default_ppo_config().evolve(
-        model=dict(model_path="random:gpt2-tiny",
+
+
+def test_moe_pipelined_ppo_full_cycle(tmp_path):
+    """MoE x PP through the PPO pipelined trainer end to end (r5: the aux
+    carry is consumed by all four pipelined method trainers): rollouts on
+    the sharded decode view, two pipelined scoring passes, GPipe train
+    step with the aux term — loss finite, steps taken."""
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import default_ppo_config
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
                    model_extra_configs=dict(dtype="float32", n_layers=4,
                                             moe_experts=4, moe_top_k=2)),
         tokenizer=dict(tokenizer_path="byte"),
-        train=dict(seq_length=32, batch_size=8, tracker=None,
-                   trainer="PipelinedPPOTrainer"),
+        train=dict(seq_length=32, batch_size=8, total_steps=2, tracker=None,
+                   eval_interval=100, checkpoint_interval=100,
+                   trainer="PipelinedPPOTrainer",
+                   checkpoint_dir=str(tmp_path / "moe_pp_ppo"), seed=13),
+        method=dict(num_rollouts=8, chunk_size=8, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=4, do_sample=True)),
         parallel=dict(data=2, pipeline=4),
     )
-    with pytest.raises(NotImplementedError, match="aux"):
-        PipelinedPPOTrainer(ppo, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+    trainer = trlx.train(
+        reward_fn=lambda samples, **kw: [float(len(s)) for s in samples],
+        prompts=["ab", "cd"] * 4,
+        eval_prompts=["ab"],
+        config=config,
+    )
+    assert trainer.iter_count >= 1
+
+
+def test_moe_aux_consumed_by_every_trainer_loss(tmp_path):
+    """Every method trainer's loss consumes the MoE aux — GSPMD ILQL and
+    RFT used to DROP the sown scalar silently (plain apply discards flax
+    intermediates; review r5): each loss must report a positive
+    moe_aux_loss stat on an expert model."""
+    import trlx_tpu as trlx
+    from trlx_tpu.data.default_configs import (
+        default_ilql_config, default_sft_config,
+    )
+    from trlx_tpu.trainer.ilql_trainer import ILQLTrainer
+    from trlx_tpu.trainer.rft_trainer import RFTTrainer
+    from trlx_tpu.trainer.pipelined_ilql_trainer import PipelinedILQLTrainer
+    from trlx_tpu.trainer.pipelined_rft_trainer import PipelinedRFTTrainer
+
+    moe_model = dict(model_path="random:gpt2-tiny", num_layers_unfrozen=-1,
+                     model_extra_configs=dict(dtype="float32", n_layers=4,
+                                              moe_experts=4, moe_top_k=2))
+    common_train = dict(seq_length=32, batch_size=8, total_steps=1,
+                        tracker=None, eval_interval=100,
+                        checkpoint_interval=100, seed=5)
+
+    # GSPMD ILQL
+    ilql_cfg = default_ilql_config().evolve(
+        model=moe_model, tokenizer=dict(tokenizer_path="byte"),
+        train=dict(**common_train, checkpoint_dir=str(tmp_path / "gi")),
+        method=dict(gen_kwargs=dict(max_new_tokens=4, top_k=4, beta=1.0,
+                                    temperature=1.0)),
+    )
+    t = ILQLTrainer(ilql_cfg)
+    t.make_experience(["good text", "bad text"] * 4, [1.0, -1.0] * 4, 32)
+    batch = jax.tree_util.tree_map(jnp.asarray,
+                                   next(iter(t.store.create_loader(8))))
+    loss, stats = t.make_loss_fn()(t.train_params, t.frozen_params, batch)
+    assert float(np.asarray(stats["moe_aux_loss"])) > 0
+    assert np.isfinite(float(np.asarray(loss)))
+
+    # GSPMD RFT
+    from trlx_tpu.trainer.rft_trainer import RFTConfig
+
+    base = default_sft_config().evolve(
+        model=moe_model, tokenizer=dict(tokenizer_path="byte"),
+        train=dict(**common_train, trainer="RFTTrainer",
+                   checkpoint_dir=str(tmp_path / "gr")),
+    )
+    from trlx_tpu.data.configs import TRLConfig
+    rft_cfg = TRLConfig(
+        train=base.train, model=base.model, tokenizer=base.tokenizer,
+        optimizer=base.optimizer, scheduler=base.scheduler,
+        method=RFTConfig(name="RFTConfig",
+                         gen_kwargs=dict(max_new_tokens=4, do_sample=True),
+                         n_generations_per_prompt=2),
+        parallel=base.parallel,
+    )
+    t = RFTTrainer(rft_cfg, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+    fake = {"input_ids": jnp.ones((4, 8), jnp.int32),
+            "attention_mask": jnp.ones((4, 8), jnp.int32)}
+    loss, stats = t.make_loss_fn()(t.train_params, t.frozen_params, fake)
+    assert float(np.asarray(stats["moe_aux_loss"])) > 0
+
+    # pipelined ILQL + RFT (the in-pipe carry)
+    pi_cfg = ilql_cfg.evolve(
+        train=dict(trainer="PipelinedILQLTrainer",
+                   checkpoint_dir=str(tmp_path / "pi")),
+        parallel=dict(data=2, pipeline=4),
+    )
+    t = PipelinedILQLTrainer(pi_cfg)
+    t.make_experience(["good text", "bad text"] * 4, [1.0, -1.0] * 4, 32)
+    batch = jax.tree_util.tree_map(jnp.asarray,
+                                   next(iter(t.store.create_loader(8))))
+    loss, stats = t.make_loss_fn()(t.train_params, t.frozen_params, batch)
+    assert float(np.asarray(stats["moe_aux_loss"])) > 0
+
+    pr_cfg = rft_cfg.evolve(
+        train=dict(trainer="PipelinedRFTTrainer",
+                   checkpoint_dir=str(tmp_path / "pr")),
+        parallel=dict(data=2, pipeline=4),
+    )
+    t = PipelinedRFTTrainer(pr_cfg, reward_fn=lambda samples, **kw: [0.0] * len(samples))
+    fake = {"input_ids": jnp.ones((8, 8), jnp.int32),
+            "attention_mask": jnp.ones((8, 8), jnp.int32)}
+    loss, stats = t.make_loss_fn()(t.train_params, t.frozen_params, fake)
+    assert float(np.asarray(stats["moe_aux_loss"])) > 0

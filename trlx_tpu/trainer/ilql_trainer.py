@@ -218,22 +218,35 @@ class ILQLTrainer(TPUTrainer):
 
             return seq2seq_loss_fn
 
+        moe = getattr(self.model_cfg, "moe_experts", 0) > 0
+
         def loss_fn(train_params, frozen_params, batch: ILQLBatch):
+            from trlx_tpu.utils.modeling import apply_with_moe_aux
+
             params = merge_params(train_params, frozen_params)
-            logits, qs, target_qs, vs, _ = model.apply(
-                {"params": params},
+            (logits, qs, target_qs, vs, _), moe_aux = apply_with_moe_aux(
+                self.model_cfg, model, params,
                 batch.input_ids,
                 batch.attention_mask,
                 position_ids(batch.attention_mask),
                 states_ixs=batch.states_ixs,
                 actions_ixs=batch.actions_ixs,
             )
-            return ilql_loss(
+            loss, stats = ilql_loss(
                 logits, qs, target_qs, vs,
                 batch.input_ids, batch.actions_ixs, batch.dones, batch.rewards,
                 tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
                 awac_scale=cfg.awac_scale, beta=cfg.beta,
             )
+            if moe:
+                # previously the sown aux was silently DROPPED here (plain
+                # apply discards intermediates) — routing pressure lost
+                loss = loss + moe_aux
+                stats = {
+                    **stats, "moe_aux_loss": moe_aux,
+                    "losses": {**stats["losses"], "loss": loss},
+                }
+            return loss, stats
 
         return loss_fn
 

@@ -91,6 +91,7 @@ def _make_ilql_1f1b_finalize(cfg):
 
 @register_trainer
 class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
+    _supports_moe_pp = True  # in-pipe aux-loss carry consumed in make_loss_fn
     # r4: under SP the 1F1B loss switches to the full-token-width
     # decomposition (ops/ilql.py ilql_fullwidth_terms): indices preshift to
     # action positions on the host, heads run at every position, and the
@@ -117,7 +118,8 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
 
     def make_loss_fn(self) -> Callable:
         cfg = self.ilql
-        fwd = self.make_stacked_lm_forward(with_hidden=True)
+        moe, moe_coef = self._moe_loss_cfg()
+        fwd = self.make_stacked_lm_forward(with_hidden=True, with_aux=moe)
         heads = ILQLHeads(
             self.model_cfg.vocab_size, cfg.two_qs,
             self.model_cfg.dtype, self.model_cfg.param_dtype,
@@ -125,20 +127,33 @@ class PipelinedILQLTrainer(PipelinedCausalMixin, ILQLTrainer):
 
         def loss_fn(train_params, frozen_params, batch: ILQLBatch):
             params = merge_params(train_params, frozen_params)
-            logits, h_final = fwd(
+            out = fwd(
                 params["lm_stacked"], params["lm_rest"],
                 batch.input_ids, batch.attention_mask,
             )
+            if moe:
+                logits, h_final, moe_aux = out
+            else:
+                logits, h_final = out
             qs, target_qs, vs = heads.apply(
                 {"params": params["ilql_heads"]}, h_final,
                 batch.states_ixs, batch.actions_ixs,
             )
-            return ilql_loss(
+            loss, stats = ilql_loss(
                 logits, qs, target_qs, vs,
                 batch.input_ids, batch.actions_ixs, batch.dones, batch.rewards,
                 tau=cfg.tau, gamma=cfg.gamma, cql_scale=cfg.cql_scale,
                 awac_scale=cfg.awac_scale, beta=cfg.beta,
             )
+            if moe:
+                # in-pipe aux carry, same coefficient as the GSPMD route
+                aux = moe_coef * moe_aux
+                loss = loss + aux
+                stats = {
+                    **stats, "moe_aux_loss": aux,
+                    "losses": {**stats["losses"], "loss": loss},
+                }
+            return loss, stats
 
         return loss_fn
 

@@ -189,9 +189,10 @@ class PipelinedCausalMixin:
             # no virtual stages, and trainers that consume the aux output.
             if not getattr(self, "_supports_moe_pp", False):
                 raise NotImplementedError(
-                    f"MoE under pipeline parallelism is wired for "
-                    "PipelinedSFTTrainer (in-pipe aux-loss carry); "
-                    f"{type(self).__name__} does not consume the aux output"
+                    "MoE under pipeline parallelism needs a trainer whose "
+                    "loss consumes the in-pipe aux-loss carry "
+                    "(Pipelined{SFT,PPO,ILQL,RFT}Trainer do); "
+                    f"{type(self).__name__} does not"
                 )
             if getattr(config.parallel, "pipeline_schedule", "gpipe") != "gpipe":
                 raise NotImplementedError(
@@ -338,6 +339,13 @@ class PipelinedCausalMixin:
         if self.config.model.num_layers_unfrozen in (-1, 0):
             return 0
         return self.split
+
+    def _moe_loss_cfg(self):
+        """(enabled, coef) for the in-pipe MoE aux-loss carry — the ONE
+        lookup all four pipelined method trainers share, so the flag/coef
+        handling cannot drift between them."""
+        return (getattr(self.model_cfg, "moe_experts", 0) > 0,
+                getattr(self.model_cfg, "moe_aux_coef", 0.0))
 
     def make_stacked_lm_forward(self, with_hidden: bool = False,
                                 with_aux: bool = False):

@@ -57,6 +57,7 @@ logger = logging.get_logger(__name__)
 
 @register_trainer
 class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
+    _supports_moe_pp = True  # in-pipe aux-loss carry consumed in make_loss_fn
     # r4: the 1F1B loss is expressed in full token width (prepare() scatters
     # the response windows to their predicting positions, CE-preshift
     # style), so it composes with sequence parallelism — the deep-model
@@ -99,7 +100,8 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
     def make_loss_fn(self) -> Callable:
         method = self.config.method
         pad_id = self.tokenizer.pad_token_id
-        fwd = self.make_stacked_lm_forward(with_hidden=True)
+        moe, moe_coef = self._moe_loss_cfg()
+        fwd = self.make_stacked_lm_forward(with_hidden=True, with_aux=moe)
         v_head = self._head_module()
 
         def loss_fn(train_params, frozen_params, batch: PPORLBatch):
@@ -114,16 +116,20 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
 
             tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
             attention_mask = (tokens != pad_id).astype(jnp.int32)
-            logits, h_final = fwd(
+            out = fwd(
                 params["lm_stacked"], params["lm_rest"], tokens, attention_mask
             )
+            if moe:
+                logits, h_final, moe_aux = out
+            else:
+                logits, h_final = out
             values_pred = v_head.apply({"params": params["v_head"]}, h_final)[..., 0]
             values_pred = values_pred[:, :-1]
             logprobs = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
 
             start = query_tensors.shape[1] - 1
             end = start + response_length
-            return ppo_loss(
+            loss, stats = ppo_loss(
                 logprobs=logprobs[:, start:end],
                 values=values_pred[:, start:end],
                 old_logprobs=batch.logprobs,
@@ -135,6 +141,15 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
                 cliprange_value=method.cliprange_value,
                 vf_coef=method.vf_coef,
             )
+            if moe:
+                # in-pipe aux carry, same coefficient as the GSPMD route
+                aux = moe_coef * moe_aux
+                loss = loss + aux
+                stats = {
+                    **stats, "moe_aux_loss": aux,
+                    "losses": {**stats["losses"], "total_loss": loss},
+                }
+            return loss, stats
 
         return loss_fn
 

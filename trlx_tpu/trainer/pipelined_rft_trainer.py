@@ -18,6 +18,7 @@ from trlx_tpu.trainer.sft_trainer import causal_lm_ce_loss
 
 @register_trainer
 class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
+    _supports_moe_pp = True  # in-pipe aux-loss carry consumed in make_loss_fn
     _sp_needs_right_padding = True  # CE loss; see PipelinedCausalMixin
     _1f1b_supports_sequence = True  # CE targets preshift globally
 
@@ -40,7 +41,8 @@ class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
         return causal_ce_1f1b_parts(model)
 
     def make_loss_fn(self) -> Callable:
-        fwd = self.make_stacked_lm_forward()
+        moe, moe_coef = self._moe_loss_cfg()
+        fwd = self.make_stacked_lm_forward(with_aux=moe)
 
         def loss_fn(train_params, frozen_params, batch):
             # CE over all real tokens, prompt included (reference
@@ -50,7 +52,13 @@ class PipelinedRFTTrainer(PipelinedCausalMixin, RFTTrainer):
             params = merge_params(train_params, frozen_params)
             input_ids = batch["input_ids"]
             attention_mask = batch["attention_mask"]
-            logits = fwd(params["lm_stacked"], params["lm_rest"], input_ids, attention_mask)
-            return causal_lm_ce_loss(logits, input_ids, attention_mask)
+            out = fwd(params["lm_stacked"], params["lm_rest"], input_ids, attention_mask)
+            if moe:
+                logits, moe_aux = out
+                loss, stats = causal_lm_ce_loss(logits, input_ids, attention_mask)
+                aux = moe_coef * moe_aux
+                return loss + aux, {**stats, "moe_aux_loss": aux,
+                                    "loss": loss + aux}
+            return causal_lm_ce_loss(out, input_ids, attention_mask)
 
         return loss_fn

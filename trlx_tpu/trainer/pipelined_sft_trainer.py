@@ -62,8 +62,7 @@ class PipelinedSFTTrainer(PipelinedCausalMixin, SFTTrainer):
         return causal_ce_1f1b_parts(model)
 
     def make_loss_fn(self) -> Callable:
-        moe = getattr(self.model_cfg, "moe_experts", 0) > 0
-        moe_coef = getattr(self.model_cfg, "moe_aux_coef", 0.0)
+        moe, moe_coef = self._moe_loss_cfg()
         fwd = self.make_stacked_lm_forward(with_aux=moe)
 
         def loss_fn(train_params, frozen_params, batch):

@@ -223,14 +223,11 @@ class PPOTrainer(TPUTrainer):
 
             moe_aux = 0.0
             if getattr(self.model_cfg, "moe_experts", 0) > 0:
-                from trlx_tpu.models.transformer import moe_aux_from_intermediates
+                from trlx_tpu.utils.modeling import apply_with_moe_aux
 
-                (logits, values_full, _), inter = model.apply(
-                    {"params": params}, tokens, attention_mask, positions,
-                    mutable=["intermediates"],
-                )
-                moe_aux = getattr(self.model_cfg, "moe_aux_coef", 0.0) * (
-                    moe_aux_from_intermediates(inter)
+                (logits, values_full, _), moe_aux = apply_with_moe_aux(
+                    self.model_cfg, model, params,
+                    tokens, attention_mask, positions,
                 )
                 logprobs, values_pred = window_from_full(logits, values_full)
             elif self._window_loss_ok():
@@ -267,7 +264,13 @@ class PPOTrainer(TPUTrainer):
                 cliprange_value=method.cliprange_value,
                 vf_coef=method.vf_coef,
             )
-            loss = loss + moe_aux
+            if getattr(self.model_cfg, "moe_experts", 0) > 0:
+                # the logged total must be the optimized objective
+                loss = loss + moe_aux
+                stats = {
+                    **stats, "moe_aux_loss": moe_aux,
+                    "losses": {**stats["losses"], "total_loss": loss},
+                }
             return loss, stats
 
         return loss_fn

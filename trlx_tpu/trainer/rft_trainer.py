@@ -61,15 +61,19 @@ class RFTTrainer(TPUTrainer):
 
     def make_loss_fn(self) -> Callable:
         model = self.model
+        moe = getattr(self.model_cfg, "moe_experts", 0) > 0
 
         def loss_fn(train_params, frozen_params, batch):
+            from trlx_tpu.utils.modeling import apply_with_moe_aux
+
             # CE over all tokens, prompt included (reference
             # accelerate_rft_trainer.py:83-88 uses labels=input_ids)
             params = merge_params(train_params, frozen_params)
             input_ids = batch["input_ids"]
             attention_mask = batch["attention_mask"]
-            logits, _, _ = model.apply(
-                {"params": params}, input_ids, attention_mask, position_ids(attention_mask)
+            (logits, _, _), moe_aux = apply_with_moe_aux(
+                self.model_cfg, model, params,
+                input_ids, attention_mask, position_ids(attention_mask),
             )
             shift_logits = logits[:, :-1, :]
             labels = input_ids[:, 1:]
@@ -77,6 +81,10 @@ class RFTTrainer(TPUTrainer):
             nll = -logprobs_of_labels(shift_logits, labels)
             n = jnp.maximum(valid.sum(), 1)
             loss = jnp.where(valid, nll, 0.0).sum() / n
+            if moe:
+                # previously the sown aux was silently DROPPED here
+                loss = loss + moe_aux
+                return loss, {"loss": loss, "moe_aux_loss": moe_aux}
             return loss, {"loss": loss}
 
         return loss_fn

@@ -78,23 +78,21 @@ class SFTTrainer(TPUTrainer):
         model = self.model
 
         moe = getattr(self.model_cfg, "moe_experts", 0) > 0
-        moe_coef = getattr(self.model_cfg, "moe_aux_coef", 0.0)
 
         def loss_fn(train_params, frozen_params, batch):
             params = merge_params(train_params, frozen_params)
             input_ids = batch["input_ids"]
             attention_mask = batch["attention_mask"]
             if moe:
-                from trlx_tpu.models.transformer import moe_aux_from_intermediates
+                from trlx_tpu.utils.modeling import apply_with_moe_aux
 
-                (logits, _, _), inter = model.apply(
-                    {"params": params}, input_ids, attention_mask,
-                    position_ids(attention_mask), mutable=["intermediates"],
+                (logits, _, _), aux = apply_with_moe_aux(
+                    self.model_cfg, model, params,
+                    input_ids, attention_mask, position_ids(attention_mask),
                 )
                 loss, stats = causal_lm_ce_loss(
                     logits, input_ids, attention_mask, batch.get("labels")
                 )
-                aux = moe_coef * moe_aux_from_intermediates(inter)
                 stats = {**stats, "moe_aux_loss": aux, "loss": loss + aux}
                 return loss + aux, stats
             logits, _, _ = model.apply(

@@ -140,3 +140,21 @@ def gather_dict(obj: Dict, process_count: Optional[int] = None) -> Dict:
 
     gathered = multihost_utils.process_allgather(obj)
     return gathered
+
+
+def apply_with_moe_aux(model_cfg, model, params, *args, **kwargs):
+    """model.apply that also returns the MoE load-balancing aux LOSS TERM
+    (coef * sum of sown per-block scalars; 0.0 when the config has no
+    experts). One helper so no GSPMD trainer can silently drop the sown
+    aux — plain apply() discards flax intermediates, which is exactly the
+    'experts collapse without routing pressure' hazard moe_aux_coef
+    exists to prevent."""
+    if getattr(model_cfg, "moe_experts", 0) > 0:
+        from trlx_tpu.models.transformer import moe_aux_from_intermediates
+
+        out, inter = model.apply(
+            {"params": params}, *args, mutable=["intermediates"], **kwargs
+        )
+        coef = getattr(model_cfg, "moe_aux_coef", 0.0)
+        return out, coef * moe_aux_from_intermediates(inter)
+    return model.apply({"params": params}, *args, **kwargs), 0.0

@@ -1,0 +1,292 @@
+"""Fault-tolerance subsystem unit tests (trlx_tpu/resilience.py): retry
+backoff, circuit breaker, atomic manifest-complete checkpoints, retention
+GC, preemption guard, and the deterministic fault injector."""
+
+import json
+import os
+import signal
+
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    FaultInjector,
+    PreemptionGuard,
+    TransientError,
+    atomic_checkpoint,
+    atomic_write_json,
+    compute_backoff,
+    find_latest_valid_checkpoint,
+    gc_checkpoints,
+    is_valid_checkpoint,
+    list_checkpoints,
+    read_manifest,
+    retry,
+    write_manifest,
+)
+
+
+# ----------------------------------------------------------------------
+# retry
+# ----------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    calls = {"n": 0}
+
+    @retry(retries=5, base_delay=0.1, jitter=0.0, sleep=sleeps.append)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("boom")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+    # exponential backoff: 0.1, 0.2 (no jitter)
+    assert sleeps == pytest.approx([0.1, 0.2])
+
+
+def test_retry_exhausts_and_raises():
+    sleeps = []
+
+    @retry(retries=2, base_delay=0.01, jitter=0.0, sleep=sleeps.append)
+    def always_fails():
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        always_fails()
+    assert len(sleeps) == 2  # retried exactly `retries` times
+
+
+def test_retry_does_not_catch_non_retryable():
+    @retry(retries=5, base_delay=0.01, sleep=lambda s: None)
+    def bug():
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        bug()
+
+
+def test_retry_max_elapsed_budget():
+    fake_time = {"t": 0.0}
+
+    def clock():
+        return fake_time["t"]
+
+    def sleep(s):
+        fake_time["t"] += s
+
+    calls = {"n": 0}
+
+    @retry(retries=100, base_delay=1.0, max_delay=1.0, jitter=0.0,
+           max_elapsed=2.5, sleep=sleep, clock=clock)
+    def always_fails():
+        calls["n"] += 1
+        fake_time["t"] += 0.1  # each attempt costs 0.1s
+        raise TransientError("down")
+
+    with pytest.raises(TransientError):
+        always_fails()
+    # budget of 2.5s with ~1.1s per cycle: far fewer than 100 attempts
+    assert calls["n"] < 6
+
+
+def test_compute_backoff_caps_and_jitters():
+    assert compute_backoff(0, 1.0, 10.0, 0.0) == 1.0
+    assert compute_backoff(10, 1.0, 10.0, 0.0) == 10.0  # capped
+    import random
+
+    rng = random.Random(0)
+    d = compute_backoff(1, 1.0, 10.0, 0.5, rng)
+    assert 1.0 <= d <= 3.0  # 2.0 * [0.5, 1.5]
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+# ----------------------------------------------------------------------
+
+
+def test_circuit_breaker_opens_after_threshold():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=3, recovery_time=10.0, clock=lambda: clock["t"])
+    for _ in range(2):
+        br.check()
+        br.record_failure()
+    br.check()  # still closed at 2 failures
+    br.record_failure()  # 3rd consecutive failure -> open
+    with pytest.raises(CircuitOpenError):
+        br.check()
+
+
+def test_circuit_breaker_half_open_probe_and_recovery():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, recovery_time=5.0, clock=lambda: clock["t"])
+    br.record_failure()
+    with pytest.raises(CircuitOpenError):
+        br.check()
+    clock["t"] = 6.0  # past recovery window: half-open admits ONE probe
+    br.check()
+    with pytest.raises(CircuitOpenError):
+        br.check()  # second call while probing still fails fast
+    br.record_success()  # probe succeeded -> closed
+    br.check()
+    assert br.state == "closed"
+
+
+def test_circuit_breaker_reopens_on_failed_probe():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, recovery_time=5.0, clock=lambda: clock["t"])
+    br.record_failure()
+    clock["t"] = 6.0
+    br.check()  # probe admitted
+    br.record_failure()  # probe failed -> re-open
+    with pytest.raises(CircuitOpenError):
+        br.check()
+
+
+# ----------------------------------------------------------------------
+# atomic checkpoints + manifest + retention
+# ----------------------------------------------------------------------
+
+
+def test_atomic_write_json_replaces_whole_file(tmp_path):
+    path = str(tmp_path / "state.json")
+    atomic_write_json(path, {"step": 1})
+    atomic_write_json(path, {"step": 2})
+    with open(path) as f:
+        assert json.load(f) == {"step": 2}
+    # no stray temp files left behind
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+def test_atomic_checkpoint_commit_and_manifest(tmp_path):
+    target = str(tmp_path / "ckpt")
+    with atomic_checkpoint(target, step=7) as stage:
+        with open(os.path.join(stage, "data.bin"), "wb") as f:
+            f.write(b"x" * 128)
+    assert is_valid_checkpoint(target)
+    assert is_valid_checkpoint(target, verify_hash=True)
+    m = read_manifest(target)
+    assert m["step"] == 7 and "wall_time" in m and "files_hash" in m
+
+
+def test_atomic_checkpoint_failure_leaves_previous_intact(tmp_path):
+    target = str(tmp_path / "ckpt")
+    with atomic_checkpoint(target, step=1) as stage:
+        with open(os.path.join(stage, "data.bin"), "wb") as f:
+            f.write(b"v1")
+    with pytest.raises(RuntimeError):
+        with atomic_checkpoint(target, step=2) as stage:
+            with open(os.path.join(stage, "data.bin"), "wb") as f:
+                f.write(b"v2")
+            raise RuntimeError("preempted mid-save")
+    # previous checkpoint untouched, no .tmp litter
+    assert read_manifest(target)["step"] == 1
+    with open(os.path.join(target, "data.bin"), "rb") as f:
+        assert f.read() == b"v1"
+    assert not os.path.exists(target + ".tmp")
+
+
+def test_truncated_checkpoint_is_skipped(tmp_path):
+    for step in (1, 2):
+        with atomic_checkpoint(str(tmp_path / f"checkpoint_{step}"), step=step) as stage:
+            with open(os.path.join(stage, "data.bin"), "wb") as f:
+                f.write(b"x")
+    newest = str(tmp_path / "checkpoint_2")
+    assert find_latest_valid_checkpoint(str(tmp_path)) == newest
+    FaultInjector.truncate_checkpoint(newest)
+    assert not is_valid_checkpoint(newest)
+    # auto-resume falls back to the previous valid one
+    assert find_latest_valid_checkpoint(str(tmp_path)) == str(tmp_path / "checkpoint_1")
+
+
+def test_hash_verification_detects_missing_file(tmp_path):
+    target = str(tmp_path / "ckpt")
+    with atomic_checkpoint(target, step=1) as stage:
+        with open(os.path.join(stage, "a.bin"), "wb") as f:
+            f.write(b"abc")
+    os.unlink(os.path.join(target, "a.bin"))
+    assert is_valid_checkpoint(target)  # manifest alone still parses
+    assert not is_valid_checkpoint(target, verify_hash=True)
+
+
+def test_find_latest_ignores_best_and_tmp(tmp_path):
+    with atomic_checkpoint(str(tmp_path / "checkpoint_1"), step=1):
+        pass
+    with atomic_checkpoint(str(tmp_path / "best_checkpoint"), step=99):
+        pass
+    os.makedirs(str(tmp_path / "checkpoint_5.tmp"))
+    assert find_latest_valid_checkpoint(str(tmp_path)) == str(tmp_path / "checkpoint_1")
+
+
+def test_gc_checkpoints_retention(tmp_path):
+    for step in range(1, 6):
+        with atomic_checkpoint(str(tmp_path / f"checkpoint_{step}"), step=step):
+            pass
+    with atomic_checkpoint(str(tmp_path / "best_checkpoint"), step=2):
+        pass
+    deleted = gc_checkpoints(str(tmp_path), keep_n=2)
+    remaining = sorted(os.listdir(tmp_path))
+    assert remaining == ["best_checkpoint", "checkpoint_4", "checkpoint_5"]
+    assert len(deleted) == 3
+    # keep_n=0 keeps everything
+    assert gc_checkpoints(str(tmp_path), keep_n=0) == []
+
+
+def test_list_checkpoints_sorted_by_step(tmp_path):
+    for step in (3, 1, 2):
+        with atomic_checkpoint(str(tmp_path / f"c{step}"), step=step):
+            pass
+    steps = [s for s, _, _ in list_checkpoints(str(tmp_path))]
+    assert steps == [1, 2, 3]
+
+
+# ----------------------------------------------------------------------
+# preemption guard + fault injector
+# ----------------------------------------------------------------------
+
+
+def test_preemption_guard_flags_and_restores_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    guard = PreemptionGuard()
+    with guard:
+        assert not guard.triggered
+        FaultInjector.deliver_signal(signal.SIGTERM)
+        assert guard.triggered
+        assert guard.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_fault_injector_schedule_is_deterministic():
+    inj = FaultInjector(schedule=[True, False, True])
+    assert [inj.should_fail() for _ in range(5)] == [True, False, True, False, False]
+    assert inj.injected == 2
+
+
+def test_fault_injector_seeded_rate_reproducible():
+    a = FaultInjector(rate=0.3, seed=42)
+    b = FaultInjector(rate=0.3, seed=42)
+    seq_a = [a.should_fail() for _ in range(50)]
+    seq_b = [b.should_fail() for _ in range(50)]
+    assert seq_a == seq_b
+    assert 0 < sum(seq_a) < 50  # actually injects some, not all
+
+
+def test_fault_injector_cycle():
+    inj = FaultInjector(schedule=[True, False], cycle=True)
+    assert [inj.should_fail() for _ in range(4)] == [True, False, True, False]
+
+
+def test_manifest_extra_fields(tmp_path):
+    target = str(tmp_path / "ckpt")
+    os.makedirs(target)
+    write_manifest(target, step=3, extra={"reason": "preempt"})
+    assert read_manifest(target)["reason"] == "preempt"
+
+
+def test_preemption_exit_code_is_distinct():
+    assert resilience.PREEMPTION_EXIT_CODE not in (0, 1, 2)

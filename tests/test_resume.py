@@ -1,0 +1,310 @@
+"""Preemption / exact-resume integration tests: SIGTERM mid-learn() writes
+a manifest-complete emergency checkpoint, auto_resume continues from it,
+and the resumed run is bit-identical to an uninterrupted one (params AND
+loss trajectory). Also covers save_optimizer honoring, truncated-checkpoint
+skipping at the trainer level, and the checkpoint_keep_n retention policy.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.resilience import FaultInjector
+from trlx_tpu.trainer.sft_trainer import SFTConfig
+from trlx_tpu.utils.loading import get_pipeline, get_trainer
+
+SAMPLES = [
+    "hello world", "foo bar baz", "lorem ipsum", "a b c",
+    "the quick brown", "fox jumps over", "the lazy dog", "pack my box",
+    "with five dozen", "liquor jugs", "sphinx of black", "quartz judge",
+    "my vow is", "how vexingly", "quick daft zebras", "jump high",
+]
+
+
+def sft_config(tmp_path, run: str, **train_overrides):
+    train = dict(
+        seq_length=24,
+        epochs=4,
+        total_steps=8,
+        batch_size=4,
+        checkpoint_interval=100,
+        eval_interval=100,
+        pipeline="PromptPipeline",
+        trainer="SFTTrainer",
+        tracker="jsonl",
+        logging_dir=str(tmp_path / run / "logs"),
+        checkpoint_dir=str(tmp_path / run / "ckpts"),
+        seed=11,
+    )
+    train.update(train_overrides)
+    return TRLConfig(
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="random:gpt2-tiny"),
+        tokenizer=TokenizerConfig(tokenizer_path="byte"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="constant"),
+        method=SFTConfig(name="sftconfig", gen_kwargs=dict(max_new_tokens=4, do_sample=False)),
+    )
+
+
+def build_trainer(config):
+    """trlx.train() without learn(): trainer + data + eval pipeline."""
+    trainer = get_trainer(config.train.trainer)(config=config)
+    trainer.make_experience(SAMPLES, config.train.seq_length)
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
+    eval_pipeline = get_pipeline(config.train.pipeline)(
+        ["hello", "foo"], max_prompt_length, trainer.tokenizer
+    )
+    trainer.add_eval_pipeline(eval_pipeline)
+    return trainer
+
+
+def read_losses(logging_dir):
+    """{step: loss} from the jsonl tracker output."""
+    out = {}
+    for name in os.listdir(logging_dir):
+        if not name.endswith(".metrics.jsonl"):
+            continue
+        with open(os.path.join(logging_dir, name)) as f:
+            for line in f:
+                row = json.loads(line)
+                loss_keys = [k for k in row if "loss" in k]
+                if loss_keys:
+                    out[row["_step"]] = row[loss_keys[0]]
+    return out
+
+
+def kill_after_steps(trainer, n: int):
+    """Deliver SIGTERM (via the deterministic injector) after the n-th
+    optimizer step, exercising the real signal -> flag -> step-boundary
+    emergency-checkpoint path."""
+    orig = trainer.train_minibatch
+    count = {"n": 0}
+
+    def wrapped(minibatch):
+        out = orig(minibatch)
+        count["n"] += 1
+        if count["n"] == n:
+            FaultInjector.deliver_signal(signal.SIGTERM)
+        return out
+
+    trainer.train_minibatch = wrapped
+
+
+def test_kill_and_resume_bit_identical(tmp_path):
+    """Run N=3 steps (mid-epoch), SIGTERM, auto-resume in a FRESH trainer,
+    and require the final params and the post-resume loss trajectory to be
+    bit-identical to an uninterrupted 8-step run."""
+    # --- uninterrupted reference run (16 samples / batch 4 = 4 steps/epoch)
+    t_ref = build_trainer(sft_config(tmp_path, "ref"))
+    t_ref.learn()
+    assert t_ref.iter_count == 8
+
+    # --- run 1: killed mid-epoch after 3 of 8 steps
+    config_b = sft_config(tmp_path, "b", auto_resume=True)
+    t1 = build_trainer(config_b)
+    kill_after_steps(t1, 3)
+    with pytest.raises(SystemExit) as exc:
+        t1.learn()
+    assert exc.value.code == resilience.PREEMPTION_EXIT_CODE
+    assert t1.iter_count == 3
+
+    # the emergency checkpoint is manifest-complete (hash verified)
+    ckpts = resilience.list_checkpoints(config_b.train.checkpoint_dir)
+    assert [s for s, _, _ in ckpts] == [3]
+    emergency = ckpts[0][2]
+    assert emergency.endswith("_preempt")
+    assert resilience.is_valid_checkpoint(emergency, verify_hash=True)
+
+    # --- run 2: fresh trainer, auto_resume picks up the emergency ckpt
+    config_b2 = sft_config(tmp_path, "b", auto_resume=True,
+                           logging_dir=str(tmp_path / "b2" / "logs"))
+    t2 = build_trainer(config_b2)
+    t2.learn()
+    assert t2.iter_count == 8
+
+    # final params bit-identical to the uninterrupted run
+    assert set(t_ref.train_params) == set(t2.train_params)
+    for k in t_ref.train_params:
+        np.testing.assert_array_equal(
+            np.asarray(t_ref.train_params[k]), np.asarray(t2.train_params[k]),
+            err_msg=str(k),
+        )
+
+    # post-resume loss trajectory (steps 4..8) bit-identical
+    ref_losses = read_losses(sft_config(tmp_path, "ref").train.logging_dir)
+    resumed_losses = read_losses(config_b2.train.logging_dir)
+    assert set(resumed_losses) == {4, 5, 6, 7, 8}
+    for step, loss in resumed_losses.items():
+        assert ref_losses[step] == loss, f"step {step}: {ref_losses[step]} != {loss}"
+
+    # and the pre-kill prefix matched too (same seed, same shuffles)
+    killed_losses = read_losses(config_b.train.logging_dir)
+    for step, loss in killed_losses.items():
+        assert ref_losses[step] == loss
+
+
+def test_retention_truncation_and_atomicity(tmp_path):
+    """One training, three guarantees: (1) checkpoint_keep_n GCs old step
+    checkpoints but never the latest; (2) a truncated (manifest-less)
+    checkpoint is skipped by auto_resume in favor of the previous valid
+    one; (3) trainer_state.json is complete/parseable with no temp litter
+    (the step-counter write is atomic)."""
+    config = sft_config(tmp_path, "trunc", checkpoint_interval=2, total_steps=8,
+                        checkpoint_keep_n=3, save_best=False)
+    trainer = build_trainer(config)
+    trainer.learn()
+    ckpt_dir = config.train.checkpoint_dir
+
+    # (1) checkpoints fired at 2,4,6,8; retention kept the newest three
+    # (gc never touching best_checkpoint is pinned by
+    # tests/test_resilience.py::test_gc_checkpoints_retention)
+    steps = [s for s, _, _ in resilience.list_checkpoints(ckpt_dir)]
+    assert steps == [4, 6, 8]
+
+    # (3) the step-counter write is atomic: always parseable, no litter
+    newest = resilience.find_latest_valid_checkpoint(ckpt_dir)
+    with open(os.path.join(newest, "trainer_state.json")) as f:
+        meta = json.load(f)
+    assert meta["iter_count"] == 8
+    assert meta["rng_key"] is not None
+    assert not any(n.endswith((".tmp", ".old")) for n in os.listdir(ckpt_dir))
+
+    # (2) truncate the newest: auto-resume must fall back to step 6
+    FaultInjector.truncate_checkpoint(newest)
+    config2 = sft_config(tmp_path, "trunc", auto_resume=True,
+                         checkpoint_interval=2, total_steps=8,
+                         checkpoint_keep_n=3, save_best=False)
+    t2 = build_trainer(config2)
+    resolved = t2._resolve_resume_checkpoint()
+    assert resolved is not None and resolved.endswith("checkpoint_6")
+    t2.load(resolved)
+    assert t2.iter_count == 6
+
+
+def test_save_optimizer_false_is_honored(tmp_path):
+    """train.save_optimizer=False: opt_state is neither saved nor restored
+    (it previously was, unconditionally)."""
+    import jax
+
+    config = sft_config(tmp_path, "noopt", save_optimizer=False, total_steps=2, epochs=1)
+    trainer = build_trainer(config)
+    trainer.learn()
+    ckpt = resilience.find_latest_valid_checkpoint(config.train.checkpoint_dir)
+    assert ckpt is not None
+    with open(os.path.join(ckpt, "trainer_state.json")) as f:
+        assert json.load(f)["has_optimizer"] is False
+
+    t2 = build_trainer(sft_config(tmp_path, "noopt2", save_optimizer=False))
+    fresh_opt = jax.tree_util.tree_leaves(t2.opt_state)
+    t2.load(ckpt)
+    assert t2.iter_count == 2
+    # params restored from the checkpoint...
+    for k in trainer.train_params:
+        np.testing.assert_array_equal(
+            np.asarray(trainer.train_params[k]), np.asarray(t2.train_params[k])
+        )
+    # ...but the optimizer state is the fresh init, untouched by load()
+    for a, b in zip(fresh_opt, jax.tree_util.tree_leaves(t2.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ppo_kill_and_resume_restores_rollout_store(tmp_path):
+    """PPO preempted mid-inner-epoch: the emergency checkpoint carries the
+    in-flight rollout store, KL controller, and running moments; the
+    resumed trainer reuses them (no fresh collection) and completes."""
+    from tests.test_trainers import count_letters_reward, ppo_config
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+    from trlx_tpu.utils.loading import get_pipeline
+
+    def build():
+        config = ppo_config(tmp_path, auto_resume=True)
+        trainer = PPOTrainer(config, reward_fn=count_letters_reward)
+        max_prompt = config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
+        trainer.add_prompt_pipeline(
+            get_pipeline("PromptPipeline")(["ab", "cd", "ef", "gh"] * 2,
+                                           max_prompt, trainer.tokenizer))
+        trainer.add_eval_pipeline(
+            get_pipeline("PromptPipeline")(["ab", "cd"] * 4, max_prompt,
+                                           trainer.tokenizer))
+        return trainer
+
+    t1 = build()
+    kill_after_steps(t1, 2)
+    with pytest.raises(SystemExit) as exc:
+        t1.learn()
+    assert exc.value.code == resilience.PREEMPTION_EXIT_CODE
+    n_rollouts = len(t1.store)
+    assert n_rollouts > 0
+    kl_value = float(t1.kl_ctl.value)
+
+    t2 = build()
+    collections = {"n": 0}
+    orig_make_experience = t2.make_experience
+
+    def counting_make_experience(*args, **kwargs):
+        collections["n"] += 1
+        return orig_make_experience(*args, **kwargs)
+
+    t2.make_experience = counting_make_experience
+    t2.learn()
+    assert t2.iter_count == 4  # finished the full run
+    assert collections["n"] == 0  # restored store reused, no re-collection
+    assert len(t2.store) == n_rollouts
+    assert float(t2.kl_ctl.value) == kl_value
+
+
+@pytest.mark.slow
+def test_subprocess_sigterm_kill(tmp_path):
+    """Real multi-process kill: SIGTERM an actual training process from
+    outside; it must exit with PREEMPTION_EXIT_CODE leaving a valid,
+    manifest-complete emergency checkpoint behind."""
+    import subprocess
+    import sys
+    import time
+
+    logdir = tmp_path / "sub" / "logs"
+    script = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import sys
+sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})
+from tests.test_resume import build_trainer, sft_config
+from pathlib import Path
+config = sft_config(Path({repr(str(tmp_path))}), "sub", total_steps=500, epochs=500)
+trainer = build_trainer(config)
+trainer.learn()
+"""
+    proc = subprocess.Popen([sys.executable, "-c", script])
+    metrics = None
+    deadline = time.time() + 300
+    # wait until at least one optimizer step is logged, then SIGTERM
+    while time.time() < deadline:
+        if logdir.exists() and any(logdir.glob("*.metrics.jsonl")):
+            losses = read_losses(str(logdir))
+            if any(s >= 1 for s in losses):
+                metrics = losses
+                break
+        if proc.poll() is not None:
+            pytest.fail(f"training subprocess died early: {proc.returncode}")
+        time.sleep(0.5)
+    assert metrics is not None, "subprocess never reached step 1"
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    assert rc == resilience.PREEMPTION_EXIT_CODE
+    ckpt_dir = str(tmp_path / "sub" / "ckpts")
+    found = resilience.find_latest_valid_checkpoint(ckpt_dir)
+    assert found is not None and found.endswith("_preempt")
+    assert resilience.is_valid_checkpoint(found, verify_hash=True)

@@ -66,6 +66,95 @@ def test_server_error_propagates(server):
         srv.shutdown()
 
 
+def _len_reward(samples, prompts=None, outputs=None, **metadata):
+    return [float(len(s)) for s in samples]
+
+
+def test_reward_client_survives_injected_5xx():
+    """30% injected 5xx rate: the retrying client still returns correct
+    scores for every request."""
+    from trlx_tpu.resilience import FaultInjector
+
+    inj = FaultInjector(rate=0.3, seed=7, mode="http_500")
+    srv = RewardModelServer(_len_reward, host="127.0.0.1", port=0, fault_injector=inj)
+    url = srv.start_background()
+    try:
+        fn = remote_reward_fn(url, retries=6, retry_base_delay=0.001,
+                              retry_max_delay=0.01, _sleep=lambda s: None)
+        for _ in range(10):
+            assert fn(["ab", "abcd"]) == [2.0, 4.0]
+        assert inj.injected > 0  # faults actually fired
+    finally:
+        srv.shutdown()
+
+
+def test_reward_client_survives_injected_drops_and_5xx():
+    """Mixed faults — dropped connections AND 5xx — at a 30% rate."""
+    from trlx_tpu.resilience import FaultInjector
+
+    inj = FaultInjector(rate=0.3, seed=11, mode="mixed")
+    srv = RewardModelServer(_len_reward, host="127.0.0.1", port=0, fault_injector=inj)
+    url = srv.start_background()
+    try:
+        fn = remote_reward_fn(url, retries=8, retry_base_delay=0.001,
+                              retry_max_delay=0.01, _sleep=lambda s: None)
+        scores = []
+        for _ in range(10):
+            scores.extend(fn(["ab", "abcd"]))
+        assert scores == [2.0, 4.0] * 10
+        assert inj.injected > 0
+    finally:
+        srv.shutdown()
+
+
+def test_reward_client_circuit_breaker_opens():
+    """After the configured consecutive-failure threshold the breaker
+    opens and subsequent calls fail fast without touching the server."""
+    from trlx_tpu.resilience import CircuitOpenError, FaultInjector, TransientError
+
+    inj = FaultInjector(rate=1.0, mode="http_500")  # server always fails
+    srv = RewardModelServer(_len_reward, host="127.0.0.1", port=0, fault_injector=inj)
+    url = srv.start_background()
+    try:
+        fn = remote_reward_fn(url, retries=0, breaker_threshold=3,
+                              breaker_recovery=60.0, _sleep=lambda s: None)
+        for _ in range(3):
+            with pytest.raises(TransientError):
+                fn(["a"])
+        requests_before = inj._calls
+        with pytest.raises(CircuitOpenError):
+            fn(["a"])
+        assert inj._calls == requests_before  # failed fast, no HTTP request
+    finally:
+        srv.shutdown()
+
+
+def test_reward_client_degrades_to_cached_mean():
+    """With fallback_to_mean, an open breaker returns the running mean of
+    previously-successful scores instead of killing the rollout."""
+    from trlx_tpu.resilience import FaultInjector
+
+    srv = RewardModelServer(_len_reward, host="127.0.0.1", port=0)
+    url = srv.start_background()
+    try:
+        fn = remote_reward_fn(url, retries=0, breaker_threshold=2,
+                              breaker_recovery=60.0, fallback_to_mean=True,
+                              _sleep=lambda s: None)
+        assert fn(["ab", "abcd"]) == [2.0, 4.0]  # healthy: mean becomes 3.0
+        srv.fault_injector = FaultInjector(rate=1.0, mode="http_500")
+        # below the threshold transient failures still propagate
+        from trlx_tpu.resilience import TransientError
+
+        with pytest.raises(TransientError):
+            fn(["xyz"])
+        # the threshold-crossing failure opens the breaker: degrade to mean
+        assert fn(["xyz"]) == [3.0]
+        # breaker open: no server round-trip, still the cached mean
+        assert fn(["xyz", "q"]) == [3.0, 3.0]
+    finally:
+        srv.shutdown()
+
+
 @pytest.mark.slow
 def test_ppo_with_remote_reward(server, monkeypatch, tmp_path):
     """Full PPO loop scoring through the HTTP reward service (the hh

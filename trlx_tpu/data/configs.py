@@ -195,6 +195,20 @@ class TrainConfig:
     save_optimizer: bool = True
     resume_from_checkpoint: Optional[str] = None
 
+    # Preemption safety (trlx_tpu/resilience.py). `auto_resume` scans
+    # checkpoint_dir on startup for the newest manifest-complete
+    # checkpoint (truncated ones are skipped) and continues from it;
+    # combined with the SIGTERM/SIGINT emergency checkpoint written at
+    # the next step boundary, a preempted run restarted with the same
+    # command loses at most one step. `checkpoint_keep_n` bounds disk:
+    # keep only the newest N step checkpoints (best_checkpoint and the
+    # latest are never GC'd); 0 keeps everything.
+    auto_resume: bool = False
+    checkpoint_keep_n: int = 0
+    # Install the SIGTERM/SIGINT emergency-checkpoint handler during
+    # learn(). Off -> signals keep their default behavior.
+    handle_preemption: bool = True
+
     tracker: Optional[str] = None
     logging_dir: Optional[str] = None
     tags: Optional[List[str]] = field(default_factory=list)

@@ -33,6 +33,11 @@ def default_ppo_config():
             pipeline="PromptPipeline",
             trainer="PPOTrainer",
             tracker=None,
+            # preemption safety: with auto_resume, restarting the same
+            # command continues from the newest valid checkpoint;
+            # checkpoint_keep_n keeps disk bounded on long runs
+            auto_resume=False,
+            checkpoint_keep_n=3,
         ),
         model=ModelConfig(model_path="random:gpt2-small", num_layers_unfrozen=2),
         tokenizer=TokenizerConfig(tokenizer_path="byte", truncation_side="right"),

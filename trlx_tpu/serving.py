@@ -22,6 +22,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from trlx_tpu import resilience
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -34,22 +35,56 @@ class RewardModelServer:
                     ...metadata}
     Response JSON: {"scores": [...]} — each score a float or a list of
     per-token floats (dense rewards pass through unchanged).
+
+    `fault_injector` (resilience.FaultInjector) deterministically injects
+    transient failures — 5xx responses or dropped connections — for
+    testing the client's retry/circuit-breaker path.
     """
 
-    def __init__(self, reward_fn: Callable, host: str = "0.0.0.0", port: int = 8500):
+    def __init__(
+        self,
+        reward_fn: Callable,
+        host: str = "0.0.0.0",
+        port: int = 8500,
+        fault_injector: Optional["resilience.FaultInjector"] = None,
+    ):
         self.reward_fn = reward_fn
         self.host = host
         self.port = port
+        self.fault_injector = fault_injector
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def _make_handler(self):
         reward_fn = self.reward_fn
+        server = self  # live reference: tests can swap fault_injector mid-run
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802
                 if self.path.rstrip("/") not in ("", "/score", "/v2/score"):
                     self.send_error(404)
+                    return
+                injector = server.fault_injector
+                if injector is not None and injector.should_fail():
+                    mode = injector.mode
+                    if mode == "mixed":  # alternate 5xx / dropped connection
+                        mode = "drop" if injector.injected % 2 else "http_500"
+                    if mode == "drop":
+                        # read the request then slam the connection shut —
+                        # the client sees a reset/short read, not an HTTP
+                        # status
+                        self.close_connection = True
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
+                    body = b'{"error": "injected transient failure"}'
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -110,15 +145,46 @@ class RewardModelServer:
             self._httpd = None
 
 
-def remote_reward_fn(url: str, timeout: float = 120.0, batch_size: int = 0) -> Callable:
+def remote_reward_fn(
+    url: str,
+    timeout: float = 120.0,
+    batch_size: int = 0,
+    retries: int = 4,
+    retry_base_delay: float = 0.25,
+    retry_max_delay: float = 10.0,
+    retry_max_elapsed: Optional[float] = None,
+    breaker_threshold: int = 8,
+    breaker_recovery: float = 30.0,
+    fallback_to_mean: bool = False,
+    _sleep: Optional[Callable[[float], None]] = None,
+) -> Callable:
     """A reward_fn that scores via a RewardModelServer (the reference's
     triton client round, ppo_hh.py:112-130). Optional client-side
-    batching for large rollout chunks."""
+    batching for large rollout chunks.
+
+    Fault tolerance (trlx_tpu/resilience.py): transient failures —
+    connection drops, timeouts, HTTP 5xx — are retried with exponential
+    backoff + jitter instead of killing the PPO run; scoring errors
+    raised by the reward_fn itself (HTTP 500 with an ``error`` payload
+    from user code, 4xx) stay fatal. After `breaker_threshold`
+    consecutive transport failures the circuit breaker opens and calls
+    fail fast for `breaker_recovery` seconds; with `fallback_to_mean`
+    an open breaker degrades to the running mean of previously returned
+    scores (zero before any success) so a rollout batch still completes
+    while the reward server restarts.
+    """
+    import http.client
     import urllib.request
 
     url = url.rstrip("/") + "/score"
+    breaker = resilience.CircuitBreaker(
+        failure_threshold=breaker_threshold, recovery_time=breaker_recovery
+    )
+    # running mean of every scalar score successfully returned, for the
+    # degrade path once the breaker opens
+    score_stats = {"sum": 0.0, "count": 0}
 
-    def call(payload: dict) -> List:
+    def raw_call(payload: dict) -> List:
         import urllib.error
 
         req = urllib.request.Request(
@@ -129,14 +195,71 @@ def remote_reward_fn(url: str, timeout: float = 120.0, batch_size: int = 0) -> C
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 out = json.loads(resp.read())
         except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read()).get("error", str(e))
-            except Exception:
-                detail = str(e)
-            raise RuntimeError(f"reward server error: {detail}") from e
+            if e.code >= 500:
+                try:
+                    detail = json.loads(e.read()).get("error", str(e))
+                except Exception:
+                    detail = str(e)
+                if "injected transient" in str(detail) or e.code in (502, 503, 504):
+                    raise resilience.TransientError(
+                        f"reward server {e.code}: {detail}"
+                    ) from e
+                raise RuntimeError(f"reward server error: {detail}") from e
+            raise RuntimeError(f"reward server error: {e}") from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            raise resilience.TransientError(f"reward server unreachable: {e}") from e
+        except http.client.HTTPException as e:
+            # dropped connection mid-response (RemoteDisconnected,
+            # IncompleteRead, BadStatusLine) — transport-level, retryable
+            raise resilience.TransientError(f"reward server dropped connection: {e}") from e
+        except json.JSONDecodeError as e:
+            # truncated body from a dying server — retryable
+            raise resilience.TransientError(f"reward server short read: {e}") from e
         if "error" in out:
             raise RuntimeError(f"reward server error: {out['error']}")
         return out["scores"]
+
+    retry_kwargs = dict(
+        retries=retries,
+        base_delay=retry_base_delay,
+        max_delay=retry_max_delay,
+        max_elapsed=retry_max_elapsed,
+        retry_on=(resilience.TransientError,),
+    )
+    if _sleep is not None:  # deterministic tests inject a fake sleep
+        retry_kwargs["sleep"] = _sleep
+    retried_call = resilience.retry(**retry_kwargs)(raw_call)
+
+    def call(payload: dict) -> List:
+        try:
+            breaker.check()
+        except resilience.CircuitOpenError:
+            if not fallback_to_mean:
+                raise
+            mean = score_stats["sum"] / max(score_stats["count"], 1)
+            logger.warning_once(
+                "Reward-server circuit open: degrading to cached mean score "
+                f"({mean:.4f}) until the server recovers"
+            )
+            return [mean] * len(payload["samples"])
+        try:
+            scores = retried_call(payload)
+        except resilience.TransientError:
+            breaker.record_failure()
+            if fallback_to_mean and breaker.state != "closed":
+                mean = score_stats["sum"] / max(score_stats["count"], 1)
+                logger.warning_once(
+                    "Reward server unreachable after retries: degrading to "
+                    f"cached mean score ({mean:.4f})"
+                )
+                return [mean] * len(payload["samples"])
+            raise
+        breaker.record_success()
+        for s in scores:
+            if np.ndim(s) == 0:
+                score_stats["sum"] += float(s)
+                score_stats["count"] += 1
+        return scores
 
     def reward_fn(samples: List[str], prompts=None, outputs=None, tokenizer=None, **metadata):
         payload_meta = {

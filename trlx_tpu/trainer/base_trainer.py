@@ -19,6 +19,8 @@ GSPMD mesh from config.parallel and jit-compiling one train step:
 
 import json
 import os
+import pickle
+import shutil
 from abc import abstractmethod
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -28,6 +30,7 @@ import numpy as np
 import optax
 from flax import traverse_util
 
+from trlx_tpu import resilience
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models import resolve_split, trainable_mask
 from trlx_tpu.parallel import MeshRuntime, infer_param_shardings
@@ -168,6 +171,20 @@ class TPUTrainer(BaseRLTrainer):
         self._generate_cache: Dict[Any, Callable] = {}
         self.iter_count = 0
         self.nth_evaluation = 0
+
+        # Preemption-safe resume state (trlx_tpu/resilience.py):
+        # _loop_pos tracks where training would continue if restarted now
+        # (epoch / inner epoch / the iter_count the current dataloader was
+        # seeded at); it is saved into every checkpoint and restored into
+        # _resume_pos by load() so a resumed run replays the exact same
+        # shuffles and minibatch order.
+        self._nan_streak = 0
+        self._loop_pos: Optional[Dict[str, int]] = None
+        self._resume_pos: Optional[Dict[str, int]] = None
+        self._resume_dir: Optional[str] = None
+        self._resumed = False
+        self._preemption_guard: Optional[resilience.PreemptionGuard] = None
+        self._best_reward = -float("inf")
 
     # ------------------------------------------------------------------
     # Abstract surface (same contract as the reference's AccelerateRLTrainer)
@@ -562,46 +579,138 @@ class TPUTrainer(BaseRLTrainer):
     # Learn / evaluate / checkpoints
     # ------------------------------------------------------------------
 
+    def _resolve_resume_checkpoint(self) -> Optional[str]:
+        """Explicit `train.resume_from_checkpoint` wins; otherwise, with
+        `train.auto_resume`, scan `checkpoint_dir` for the newest
+        manifest-complete checkpoint (truncated ones are skipped in favor
+        of the previous valid one)."""
+        cfg = self.config.train
+        if cfg.resume_from_checkpoint:
+            if os.path.exists(cfg.resume_from_checkpoint):
+                return os.path.abspath(cfg.resume_from_checkpoint)
+            logger.warning(
+                f"resume_from_checkpoint={cfg.resume_from_checkpoint} does "
+                "not exist; starting fresh"
+            )
+        if cfg.auto_resume:
+            found = resilience.find_latest_valid_checkpoint(cfg.checkpoint_dir)
+            if found:
+                logger.info(f"auto_resume: continuing from {found}")
+            else:
+                logger.info(
+                    f"auto_resume: no valid checkpoint under "
+                    f"'{cfg.checkpoint_dir}'; starting fresh"
+                )
+            return found
+        return None
+
     def learn(self):
-        """Outer loop (reference accelerate_base_trainer.py:518-652)."""
+        """Outer loop (reference accelerate_base_trainer.py:518-652), with
+        preemption handling: SIGTERM/SIGINT requests an emergency
+        checkpoint at the next step boundary, after which the process
+        exits with resilience.PREEMPTION_EXIT_CODE so schedulers can
+        restart it (train.auto_resume picks the run back up)."""
         logger.info("Starting training")
-        self.prepare_learning()
         self.iter_count = 0
         self.nth_evaluation = 0
+        self._loop_pos = None
+        self._resume_pos = None
+        self._best_reward = -float("inf")
+        self._resumed = False
+        self._resume_dir = self._resolve_resume_checkpoint()
+        if self._resume_dir:
+            # load() BEFORE prepare_learning so restored state (RNG, step,
+            # rollout store) feeds experience collection and loader seeds
+            self.load(self._resume_dir)
+            self._resumed = True
+        self.prepare_learning()
 
-        if self.config.train.resume_from_checkpoint and os.path.exists(
-            self.config.train.resume_from_checkpoint
-        ):
-            self.load(self.config.train.resume_from_checkpoint)
+        if not self._resumed:
+            results = self.evaluate()
+            self.tracker.log(results, step=self.iter_count)
+        # on resume the initial eval is skipped: it would consume PRNG
+        # splits the uninterrupted run never drew, breaking bit-identical
+        # continuation (it was already logged before the preemption)
 
-        results = self.evaluate()
-        self.tracker.log(results, step=self.iter_count)
-
-        best_reward = -float("inf")
         clock = Clock()
+        guard = None
+        if self.config.train.handle_preemption:
+            guard = resilience.PreemptionGuard().install()
+        self._preemption_guard = guard
 
         try:
-            return self._learn_loop(best_reward, clock)
+            return self._learn_loop(self._best_reward, clock)
+        except resilience.PreemptionInterrupt as e:
+            logger.warning(
+                f"Preempted (signal {e.signum}); emergency checkpoint at "
+                f"step {self.iter_count} under "
+                f"'{self.config.train.checkpoint_dir}'. Exiting with code "
+                f"{resilience.PREEMPTION_EXIT_CODE}."
+            )
+            raise SystemExit(resilience.PREEMPTION_EXIT_CODE) from e
         finally:
+            if guard is not None:
+                guard.uninstall()
+            self._preemption_guard = None
             if getattr(self, "_profiling", False):
                 jax.profiler.stop_trace()
                 self._profiling = False
+
+    def _next_pos(self, epoch_idx: int, inner_idx: int) -> Dict[str, int]:
+        """Continuation position AFTER inner epoch (epoch_idx, inner_idx)
+        completes, with the current iter_count as the next loader seed."""
+        inner_idx += 1
+        if inner_idx >= self.n_inner_epochs:
+            return {"epoch": epoch_idx + 1, "inner": 0, "epoch_start_iter": self.iter_count}
+        return {"epoch": epoch_idx, "inner": inner_idx, "epoch_start_iter": self.iter_count}
 
     def _learn_loop(self, best_reward, clock):
         results = {}
         fuse = self.config.train.fuse_inner_epoch and self.num_mb == 1
         fuse_all = self.config.train.fuse_all_inner_epochs and self.num_mb == 1
-        for _ in range(self.config.train.epochs):
+        # Exact resume: pos carries (epoch, inner epoch, and the iter_count
+        # the interrupted inner epoch's dataloader was seeded at); already-
+        # consumed minibatches = iter_count - epoch_start_iter are skipped
+        # so the continuation replays the original shuffle and order.
+        pos = self._resume_pos
+        self._resume_pos = None
+        start_epoch = pos["epoch"] if pos else 0
+        if pos:
+            logger.info(
+                f"Resuming at epoch {pos['epoch']}, inner epoch "
+                f"{pos['inner']}, step {self.iter_count}"
+            )
+            if fuse_all and (
+                pos["inner"] or self.iter_count != pos["epoch_start_iter"]
+            ):
+                # fuse_all checkpoints are only taken at epoch boundaries;
+                # a mid-epoch position means the checkpoint came from a
+                # non-fused run — the fused dispatch cannot skip inside an
+                # epoch, so the interrupted epoch restarts from its start
+                logger.warning(
+                    "Resuming a MID-EPOCH checkpoint with "
+                    "fuse_all_inner_epochs=True: the interrupted epoch "
+                    "restarts from its beginning (resume with the original "
+                    "fusion setting for an exact continuation)"
+                )
+        for epoch_idx in range(start_epoch, self.config.train.epochs):
             if fuse_all:
                 # every inner epoch in ONE dispatch; host precomputes the
                 # per-epoch reshuffles
                 self._maybe_profile_step()
+                self._loop_pos = {
+                    "epoch": epoch_idx, "inner": 0, "epoch_start_iter": self.iter_count
+                }
                 loaders = [
                     self.create_train_dataloader(seed_offset=i)
                     for i in range(self.n_inner_epochs)
                 ]
                 stats, n_steps = self.train_inner_epochs_fused(loaders)
                 self.iter_count += n_steps
+                # a checkpoint taken now must continue at the NEXT epoch
+                self._loop_pos = {
+                    "epoch": epoch_idx + 1, "inner": 0, "epoch_start_iter": self.iter_count
+                }
                 res, best_reward, done = self._post_step(
                     stats, clock, best_reward, n_steps=n_steps
                 )
@@ -619,13 +728,33 @@ class TPUTrainer(BaseRLTrainer):
                     self.post_backward_callback()
                 self.post_epoch_callback()
                 continue
-            for _ in range(self.n_inner_epochs):
-                train_dataloader = self.create_train_dataloader()
-                if fuse:
+            inner_start = pos["inner"] if pos and epoch_idx == start_epoch else 0
+            for inner_idx in range(inner_start, self.n_inner_epochs):
+                resuming_here = (
+                    pos is not None and epoch_idx == start_epoch and inner_idx == inner_start
+                )
+                if resuming_here:
+                    epoch_start_iter = pos["epoch_start_iter"]
+                    pos = None  # consumed
+                else:
+                    epoch_start_iter = self.iter_count
+                # seed_offset re-derives the interrupted epoch's loader
+                # seed (config.seed + epoch_start_iter) from the restored
+                # iter_count, reproducing the original shuffle
+                train_dataloader = self.create_train_dataloader(
+                    seed_offset=epoch_start_iter - self.iter_count
+                )
+                skip_steps = self.iter_count - epoch_start_iter
+                self._loop_pos = {
+                    "epoch": epoch_idx, "inner": inner_idx,
+                    "epoch_start_iter": epoch_start_iter,
+                }
+                if fuse and skip_steps == 0:
                     # one jitted lax.scan dispatch for the whole inner epoch
                     self._maybe_profile_step()
                     stats, n_steps = self.train_inner_epoch_fused(train_dataloader)
                     self.iter_count += n_steps
+                    self._loop_pos = self._next_pos(epoch_idx, inner_idx)
                     res, best_reward, done = self._post_step(
                         stats, clock, best_reward, n_steps=n_steps
                     )
@@ -634,7 +763,17 @@ class TPUTrainer(BaseRLTrainer):
                         return results
                     self.post_backward_callback()
                     continue
-                for minibatch in MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb):
+                if fuse and skip_steps:
+                    logger.warning(
+                        "Mid-epoch resume with fuse_inner_epoch: running "
+                        "this inner epoch per-step to skip the "
+                        f"{skip_steps} already-trained minibatches"
+                    )
+                for mb_idx, minibatch in enumerate(
+                    MiniBatchIterator(train_dataloader, self.mb_size, self.num_mb)
+                ):
+                    if mb_idx < skip_steps:
+                        continue  # already trained before the preemption
                     self._maybe_profile_step()
                     stats = self.train_minibatch(minibatch)
                     self.iter_count += 1
@@ -654,6 +793,7 @@ class TPUTrainer(BaseRLTrainer):
         Returns (eval results, best_reward, done)."""
         results = {}
         done = self.iter_count >= self.total_steps
+        self._best_reward = best_reward
 
         def crossed(interval: int) -> bool:
             return self.iter_count // interval > (self.iter_count - n_steps) // interval
@@ -666,11 +806,25 @@ class TPUTrainer(BaseRLTrainer):
         stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
         self._check_divergence(stats)
 
+        guard = self._preemption_guard
+        if guard is not None and guard.triggered:
+            # preemption requested mid-epoch: write a manifest-complete
+            # emergency checkpoint at this step boundary and exit with the
+            # distinct code; auto_resume continues from here bit-identically
+            self._emergency_save(guard.signum)
+            raise resilience.PreemptionInterrupt(
+                guard.signum, self.config.train.checkpoint_dir
+            )
+
         if crossed(self.config.train.checkpoint_interval) or done:
             subfolder = f"checkpoint_{self.iter_count:0{len(str(self.total_steps))}d}"
             directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
             self.save(directory)
             self.save_pretrained(os.path.join(directory, "hf_model"))
+            if self.config.train.checkpoint_keep_n > 0 and jax.process_index() == 0:
+                resilience.gc_checkpoints(
+                    self.config.train.checkpoint_dir, self.config.train.checkpoint_keep_n
+                )
         stats["time/step"] = clock.tick(self.config.train.batch_size * n_steps) / n_steps
         stats["learning_rate"] = float(np.asarray(self.lr_schedule(self.iter_count)))
 
@@ -695,6 +849,7 @@ class TPUTrainer(BaseRLTrainer):
                     )
                 if current > best_reward:
                     best_reward = current
+                    self._best_reward = current
                     directory = os.path.join(
                         self.config.train.checkpoint_dir, "best_checkpoint"
                     )
@@ -868,41 +1023,147 @@ class TPUTrainer(BaseRLTrainer):
     # Checkpointing (orbax) + HF export
     # ------------------------------------------------------------------
 
+    def _sync_hosts(self, tag: str):
+        """Barrier across hosts (no-op single-process): checkpoint staging
+        and promotion must not race the collective orbax write."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"trlx_tpu_ckpt_{tag}")
+
+    def _extra_resume_state(self) -> Dict[str, Any]:
+        """Trainer-specific host state to include in checkpoints (e.g. the
+        PPO rollout store and KL controller). Must be picklable."""
+        return {}
+
+    def _load_extra_resume_state(self, state: Dict[str, Any]) -> None:
+        """Inverse of _extra_resume_state."""
+
+    def _resume_state_dict(self) -> Dict[str, Any]:
+        """Host-side trainer state beyond the param/optimizer trees: the
+        step counter, PRNG key, nan-guard streak, loop position, and best
+        reward — everything needed for a bit-identical continuation."""
+        best = self._best_reward
+        return {
+            "iter_count": self.iter_count,
+            "rng_key": np.asarray(self.rng).tolist(),
+            "nan_streak": self._nan_streak,
+            "loop_pos": self._loop_pos,
+            "best_reward": best if np.isfinite(best) else None,
+            "has_optimizer": bool(self.config.train.save_optimizer),
+        }
+
     def save(self, directory: Optional[str] = None):
-        """Save full trainer state (params, optimizer, step) with orbax
-        (reference: accelerator.save_state, accelerate_base_trainer.py:309-317)."""
+        """Save full trainer state with orbax (reference:
+        accelerator.save_state, accelerate_base_trainer.py:309-317),
+        atomically: everything is staged in a sibling `.tmp` directory,
+        `manifest.json` is written last, and one `os.replace` promotes the
+        stage — a preemption mid-save can never corrupt an existing
+        checkpoint or leave a half-written one that auto_resume would
+        pick up. Optimizer state is included iff `train.save_optimizer`.
+        Saved state covers the PRNG key, loop position, and nan-guard
+        counter so a resumed run is bit-identical to an uninterrupted one.
+        """
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory or self.config.train.checkpoint_dir)
-        ckptr = ocp.PyTreeCheckpointer()
+        tmp, old = directory + ".tmp", directory + ".old"
+        is_primary = jax.process_index() == 0
+        if is_primary:
+            for stale in (tmp, old):
+                if os.path.isdir(stale):
+                    shutil.rmtree(stale, ignore_errors=True)
+        self._sync_hosts("stage")
+
         state = {
             "train_params": self.train_params,
             "frozen_params": self.frozen_params,
-            "opt_state": self.opt_state,
         }
-        ckptr.save(os.path.join(directory, "state"), state, force=True)
-        with open(os.path.join(directory, "trainer_state.json"), "w") as f:
-            json.dump({"iter_count": self.iter_count}, f)
+        if self.config.train.save_optimizer:
+            state["opt_state"] = self.opt_state
+        ocp.PyTreeCheckpointer().save(os.path.join(tmp, "state"), state, force=True)
+
+        if is_primary:
+            resilience.atomic_write_json(
+                os.path.join(tmp, "trainer_state.json"), self._resume_state_dict()
+            )
+            extra = self._extra_resume_state()
+            if extra:
+                with open(os.path.join(tmp, "extra_state.pkl"), "wb") as f:
+                    pickle.dump(extra, f)
+        self._sync_hosts("commit")
+        if is_primary:
+            resilience.write_manifest(tmp, self.iter_count)
+            if os.path.isdir(directory):
+                # os.replace cannot overwrite a non-empty dir: swap the old
+                # checkpoint aside, promote the stage, then drop the old
+                os.replace(directory, old)
+            os.replace(tmp, directory)
+            shutil.rmtree(old, ignore_errors=True)
+        self._sync_hosts("done")
 
     def load(self, directory: str):
         import orbax.checkpoint as ocp
 
         directory = os.path.abspath(directory)
-        ckptr = ocp.PyTreeCheckpointer()
-        target = {
-            "train_params": self.train_params,
-            "frozen_params": self.frozen_params,
-            "opt_state": self.opt_state,
-        }
-        state = ckptr.restore(os.path.join(directory, "state"), item=target)
-        self.train_params = state["train_params"]
-        self.frozen_params = state["frozen_params"]
-        self.opt_state = state["opt_state"]
+        if not resilience.is_valid_checkpoint(directory):
+            # explicit user-given path: load anyway (pre-manifest layouts),
+            # but say the completeness guarantee does not apply
+            logger.warning(
+                f"Checkpoint {directory} has no manifest (pre-atomic layout "
+                "or truncated save); loading without completeness guarantees"
+            )
+
+        meta: Dict[str, Any] = {"iter_count": 0}
         path = os.path.join(directory, "trainer_state.json")
         if os.path.exists(path):
             with open(path) as f:
-                self.iter_count = json.load(f)["iter_count"]
+                meta = json.load(f)
+
+        has_opt = bool(meta.get("has_optimizer", True))
+        target = {
+            "train_params": self.train_params,
+            "frozen_params": self.frozen_params,
+        }
+        if has_opt:
+            target["opt_state"] = self.opt_state
+        state = ocp.PyTreeCheckpointer().restore(os.path.join(directory, "state"), item=target)
+        self.train_params = state["train_params"]
+        self.frozen_params = state["frozen_params"]
+        if has_opt:
+            self.opt_state = state["opt_state"]
+        else:
+            logger.warning(
+                "Checkpoint was saved with train.save_optimizer=False; "
+                "optimizer state starts fresh (momentum/variance reset)"
+            )
+
+        self.iter_count = int(meta.get("iter_count", 0))
+        if meta.get("rng_key") is not None:
+            self.rng = jnp.asarray(np.asarray(meta["rng_key"], dtype=np.uint32))
+        self._nan_streak = int(meta.get("nan_streak", 0))
+        self._resume_pos = meta.get("loop_pos")
+        self._loop_pos = meta.get("loop_pos")
+        if meta.get("best_reward") is not None:
+            self._best_reward = float(meta["best_reward"])
+
+        extra_path = os.path.join(directory, "extra_state.pkl")
+        if os.path.exists(extra_path):
+            with open(extra_path, "rb") as f:
+                self._load_extra_resume_state(pickle.load(f))
         logger.info(f"Restored checkpoint from {directory} at step {self.iter_count}")
+
+    def _emergency_save(self, signum: Optional[int]):
+        """Write the preemption checkpoint. Named after the step with a
+        `_preempt` suffix; auto_resume finds it by manifest step, so the
+        name only aids humans."""
+        width = len(str(getattr(self, "total_steps", 0) or 0))
+        subfolder = f"checkpoint_{self.iter_count:0{width}d}_preempt"
+        directory = os.path.join(self.config.train.checkpoint_dir, subfolder)
+        logger.warning(
+            f"Writing emergency checkpoint (signal {signum}) to {directory}"
+        )
+        self.save(directory)
 
     def save_pretrained(self, directory: Optional[str] = None, **kwargs):
         """Portable export: HF-layout state dict for GPT2/Llama families

@@ -605,6 +605,32 @@ class PPOTrainer(TPUTrainer):
         self.store.clear_history()
         self.make_experience(self.config.method.num_rollouts, self.iter_count)
 
+    def _extra_resume_state(self):
+        """PPO host state for exact resume: the in-flight rollout store
+        (regenerating it would consume PRNG splits the interrupted run
+        never drew), the KL controller, and the reward running moments."""
+        return {
+            "store_history": list(self.store.history),
+            "kl_ctl_value": float(self.kl_ctl.value),
+            "mean_kl": float(self.mean_kl),
+            "running_moments": {
+                "mean": self.running_moments.mean,
+                "std": self.running_moments.std,
+                "var": self.running_moments.var,
+                "count": self.running_moments.count,
+            },
+        }
+
+    def _load_extra_resume_state(self, state):
+        if "store_history" in state:
+            self.store.clear_history()
+            self.store.push(state["store_history"])
+        if "kl_ctl_value" in state:
+            self.kl_ctl.value = state["kl_ctl_value"]
+        self.mean_kl = state.get("mean_kl", self.mean_kl)
+        for k, v in state.get("running_moments", {}).items():
+            setattr(self.running_moments, k, v)
+
     # ------------------------------------------------------------------
     # Low-sync pipelined cycle: one blocking host fetch per PPO iteration
     # ------------------------------------------------------------------
@@ -1073,7 +1099,17 @@ class PPOTrainer(TPUTrainer):
 
     def prepare_learning(self):
         self.eval_dataloader = self.eval_pipeline.create_loader(self.config.method.chunk_size)
-        self.make_experience(self.config.method.num_rollouts)
+        if self._resumed and len(self.store) > 0:
+            # exact resume: the checkpoint restored the in-flight rollout
+            # store (load() runs before prepare_learning); collecting a
+            # fresh one here would both waste a collection and consume PRNG
+            # splits the interrupted run never drew
+            logger.info(
+                f"Resume: reusing the restored rollout store "
+                f"({len(self.store)} rollouts); skipping collection"
+            )
+        else:
+            self.make_experience(self.config.method.num_rollouts)
         self.train_dataloader = self.create_train_dataloader()
         self.n_inner_epochs = self.config.method.ppo_epochs
         self.total_steps = (

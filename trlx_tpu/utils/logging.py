@@ -88,6 +88,18 @@ class MultiProcessAdapter(logging.LoggerAdapter):
     MultiProcessAdapter (trlx/utils/logging.py:105-142).
     """
 
+    _once_seen = set()
+
+    def warning_once(self, msg, *args, **kwargs):
+        """Emit a warning only the first time this exact message is seen —
+        for per-call paths (retries, fallbacks) that would otherwise flood
+        the log with one line per rollout sample."""
+        key = (self.logger.name, str(msg))
+        if key in MultiProcessAdapter._once_seen:
+            return
+        MultiProcessAdapter._once_seen.add(key)
+        self.log(WARNING, msg, *args, **kwargs)
+
     def log(self, level, msg, *args, **kwargs):
         ranks = kwargs.pop("ranks", [0])
         process_index = _process_index()

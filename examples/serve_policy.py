@@ -1,0 +1,64 @@
+"""Serve a trained policy over HTTP with continuous batching.
+
+The serving-side counterpart of examples/inference.py: load a
+`save_pretrained` export (or a random preset for smoke tests) and expose
+it through the slot-pool inference server (`trlx_tpu/inference/`,
+docs/serving.md):
+
+    # serve an export, hot-reloading new checkpoints from a training run
+    python examples/serve_policy.py '{"checkpoint": "ckpts/hf_model",
+                                      "watch_dir": "ckpts", "port": 8600}'
+
+    # smoke-serve a random tiny model
+    python examples/serve_policy.py '{"checkpoint": "random:gpt2-tiny"}'
+
+    # then, from anywhere:
+    curl -s localhost:8600/generate -d '{"prompt": "hello", "max_new_tokens": 32}'
+    curl -s localhost:8600/healthz
+    curl -s localhost:8600/metrics
+
+Any dotted TRLConfig key in the hparams JSON overrides the config — the
+`inference.*` section holds the serving knobs (slots, queue depth,
+deadlines, gen_kwargs; docs/configs.md).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def main(hparams=None):
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.data.default_configs import default_sft_config
+
+    hparams = dict(hparams if hparams is not None else
+                   (json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}))
+    checkpoint = hparams.pop("checkpoint")
+    resume = hparams.pop("resume", None)
+    tokenizer = hparams.pop("tokenizer", "byte")
+    port = int(hparams.pop("port", 8600))
+    watch_dir = hparams.pop("watch_dir", None)
+    background = hparams.pop("background", False)  # tests set this
+
+    config = default_sft_config().evolve(
+        model=dict(model_path=checkpoint),
+        tokenizer=dict(tokenizer_path=tokenizer),
+        train=dict(total_steps=0, tracker=None,
+                   checkpoint_dir=os.path.join("/tmp", "_serve_ckpt")),
+        inference=dict(port=port, watch_dir=watch_dir),
+    )
+    if hparams:
+        config = TRLConfig.update(config, hparams)
+
+    from trlx_tpu.trainer.sft_trainer import SFTTrainer
+
+    trainer = SFTTrainer(config)
+    if resume:
+        trainer.load(resume)
+    return trainer.serve(background=background)
+
+
+if __name__ == "__main__":
+    main()

@@ -121,3 +121,27 @@ def test_clock_and_misc():
     loader = [1, 2]
     it = iter(infinite_dataloader(loader))
     assert [next(it) for _ in range(5)] == [1, 2, 1, 2, 1]
+
+
+def test_jsonl_tracker_coerces_bools_and_records_dropped(tmp_path):
+    """JSONLTracker logs bools as 0/1 and writes non-numeric keys (once)
+    to a .meta.json sidecar instead of silently discarding them."""
+    import json
+
+    from trlx_tpu.utils.tracking import JSONLTracker
+
+    tracker = JSONLTracker({}, "run", logging_dir=str(tmp_path))
+    tracker.log({"loss": 1.5, "diverged": True, "resumed": False,
+                 "note": "hello", "table": [1, 2]}, step=0)
+    tracker.log({"loss": 1.0, "note": "again", "other": {"a": 1}}, step=1)
+    tracker.finish()
+
+    rows = [json.loads(l) for l in open(tmp_path / "run.metrics.jsonl")]
+    assert rows[0]["loss"] == 1.5
+    assert rows[0]["diverged"] == 1 and rows[0]["resumed"] == 0
+    assert "note" not in rows[0] and "table" not in rows[0]
+
+    meta = json.load(open(tmp_path / "run.metrics.meta.json"))
+    assert meta["dropped_keys"] == {
+        "note": "str", "table": "list", "other": "dict"
+    }

@@ -168,6 +168,58 @@ class ParallelConfig:
 
 
 @dataclass
+class InferenceConfig:
+    """Policy inference server (trlx_tpu/inference/): continuous-batching
+    generation-as-a-service over a slot-based KV-cache pool.
+
+    :param num_slots: KV-cache slots = max concurrent decodes. Each slot
+        holds a (max_prompt_len + max_new_tokens)-long cache row.
+    :param max_prompt_len: longest admissible prompt (rounded up to
+        `prompt_bucket`); longer submissions are rejected with HTTP 400.
+    :param max_new_tokens: engine-wide generation budget; requests may
+        ask for less via their own `max_new_tokens`, never more (it
+        sizes the cache).
+    :param max_prefill_batch: rows per jitted prefill call; admission
+        chunks bigger batches.
+    :param prompt_bucket: prompt widths compile per multiple-of-this
+        bucket (the `_bucket_prompts` idiom) to bound recompilation.
+    :param max_queue_depth: queued requests beyond this are rejected
+        with HTTP 503 + Retry-After (explicit backpressure).
+    :param max_wait_s: admission waits up to this long for more queued
+        requests so prefills batch together (ignored when the pool is
+        idle).
+    :param default_deadline_s: per-request deadline when the request
+        doesn't carry one; None = no deadline. Expired requests answer
+        HTTP 504 and free their slot.
+    :param watch_dir: checkpoint directory to watch for hot-reload; the
+        newest manifest-complete checkpoint is swapped in live.
+    :param reload_interval_s: watcher poll interval.
+    :param gen_kwargs: serving-time generation knobs, overriding the
+        method's `gen_kwargs` (HF names: temperature, top_k, top_p,
+        do_sample, ...). Fixed at server start — per-request overrides
+        are limited to max_new_tokens.
+    """
+
+    num_slots: int = 8
+    max_prompt_len: int = 256
+    max_new_tokens: int = 64
+    max_prefill_batch: int = 8
+    prompt_bucket: int = 32
+    max_queue_depth: int = 64
+    max_wait_s: float = 0.01
+    default_deadline_s: Optional[float] = None
+    host: str = "0.0.0.0"
+    port: int = 8600
+    watch_dir: Optional[str] = None
+    reload_interval_s: float = 5.0
+    gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]):
+        return cls(**config)
+
+
+@dataclass
 class TrainConfig:
     """Training-run config. Field set mirrors reference TrainConfig
     (trlx/data/configs.py:140-236) so user configs carry over unchanged."""
@@ -270,6 +322,7 @@ class TRLConfig:
     tokenizer: TokenizerConfig
     train: TrainConfig
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    inference: InferenceConfig = field(default_factory=InferenceConfig)
 
     @classmethod
     def load_yaml(cls, yml_fp: str):
@@ -286,6 +339,7 @@ class TRLConfig:
             "tokenizer": dict(self.tokenizer.__dict__),
             "train": dict(self.train.__dict__),
             "parallel": dict(self.parallel.__dict__),
+            "inference": dict(self.inference.__dict__),
         }
 
     def evolve(self, **kwargs) -> "TRLConfig":
@@ -298,6 +352,7 @@ class TRLConfig:
     @classmethod
     def from_dict(cls, config: Dict):
         parallel = config.get("parallel")
+        inference = config.get("inference")
         return cls(
             method=get_method(config["method"]["name"]).from_dict(config["method"]),
             model=ModelConfig.from_dict(config["model"]),
@@ -306,6 +361,7 @@ class TRLConfig:
             scheduler=SchedulerConfig.from_dict(config["scheduler"]),
             train=TrainConfig.from_dict(config["train"]),
             parallel=ParallelConfig.from_dict(parallel) if parallel else ParallelConfig(),
+            inference=InferenceConfig.from_dict(inference) if inference else InferenceConfig(),
         )
 
     @classmethod

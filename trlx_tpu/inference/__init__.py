@@ -1,0 +1,32 @@
+"""Policy inference subsystem: generation-as-a-service.
+
+- `engine` — continuous-batching `InferenceEngine` over a slot-based
+  KV-cache pool (jitted prefill / decode_step);
+- `scheduler` — FIFO admission, max-wait batching, bounded queue with
+  backpressure, per-request deadlines;
+- `server` — HTTP `POST /generate` + `/healthz` + Prometheus `/metrics`,
+  checkpoint hot-reload;
+- `client` — `remote_generate` on the shared retry/circuit-breaker stack.
+"""
+
+from trlx_tpu.inference.client import remote_generate
+from trlx_tpu.inference.engine import InferenceEngine
+from trlx_tpu.inference.metrics import InferenceMetrics
+from trlx_tpu.inference.scheduler import InferenceRequest, QueueFullError, Scheduler
+from trlx_tpu.inference.server import (
+    CheckpointWatcher,
+    InferenceServer,
+    load_checkpoint_params,
+)
+
+__all__ = [
+    "CheckpointWatcher",
+    "InferenceEngine",
+    "InferenceMetrics",
+    "InferenceRequest",
+    "InferenceServer",
+    "QueueFullError",
+    "Scheduler",
+    "load_checkpoint_params",
+    "remote_generate",
+]

@@ -1,0 +1,70 @@
+"""Remote generation client.
+
+`remote_generate(url)` mirrors `serving.remote_reward_fn`: a callable
+backed by the SAME retry/circuit-breaker HTTP stack
+(`trlx_tpu.utils.http.RetryingJSONClient`), so the server's 503
+backpressure answers (Retry-After) and transient transport failures are
+retried with backoff instead of surfacing to the caller, and a dead
+server trips the breaker to fail fast.
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Union
+
+from trlx_tpu.utils.http import RetryingJSONClient
+
+
+def remote_generate(
+    url: str,
+    timeout: float = 300.0,
+    retries: int = 4,
+    retry_base_delay: float = 0.25,
+    retry_max_delay: float = 10.0,
+    retry_max_elapsed: Optional[float] = None,
+    breaker_threshold: int = 8,
+    breaker_recovery: float = 30.0,
+    concurrency: int = 8,
+    _sleep: Optional[Callable[[float], None]] = None,
+) -> Callable:
+    """Build a client for an `InferenceServer`.
+
+    The returned callable accepts one prompt (str or token-id list) or a
+    list of prompts; lists fan out over `concurrency` threads — the
+    server's continuous batching turns the concurrent singles into one
+    shared decode batch. Per-call kwargs: `max_new_tokens`, `deadline_s`.
+    Returns the response dict (or list of dicts): `text` (when the
+    server has a tokenizer), `token_ids`, `finish_reason`, `latency_s`.
+    """
+    client = RetryingJSONClient(
+        url.rstrip("/") + "/generate",
+        timeout=timeout,
+        retries=retries,
+        retry_base_delay=retry_base_delay,
+        retry_max_delay=retry_max_delay,
+        retry_max_elapsed=retry_max_elapsed,
+        breaker_threshold=breaker_threshold,
+        breaker_recovery=breaker_recovery,
+        error_label="inference server",
+        _sleep=_sleep,
+    )
+
+    def one(prompt: Union[str, List[int]], **kwargs) -> Dict:
+        payload = dict(kwargs)
+        if isinstance(prompt, str):
+            payload["prompt"] = prompt
+        else:
+            payload["prompt_ids"] = list(map(int, prompt))
+        return client.post(payload)
+
+    def generate(prompts, **kwargs):
+        if isinstance(prompts, str) or (
+            isinstance(prompts, (list, tuple))
+            and prompts
+            and isinstance(prompts[0], int)
+        ):
+            return one(prompts, **kwargs)
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            return list(pool.map(lambda p: one(p, **kwargs), prompts))
+
+    generate.client = client  # expose breaker state for callers/tests
+    return generate

@@ -1,0 +1,328 @@
+"""Continuous-batching inference engine over a slot-based KV-cache pool.
+
+The training sampler (`trlx_tpu/ops/sampling.py`) is one compiled
+`lax.while_loop`: the whole batch prefills together and the program runs
+until EVERY row finishes — fine for rollouts, fatal for serving, where a
+40-token reply would wait on a 400-token neighbor. This engine refactors
+that monolith into the two Orca/vLLM-style primitives:
+
+- ``prefill``: jitted per (rows, prompt-width) bucket — run the model's
+  cached prefill over a left-padded prompt batch against a full-length
+  cache, returning the per-row KV cache rows + last-position logits;
+- ``decode_step``: jitted once — sample one token for every ACTIVE slot
+  of the pool and advance each slot's own cache column
+  (`TransformerLM.decode_step_rows`; rows sit at different depths).
+
+Slots are freed the step their request finishes (eos / length budget /
+cancel) and newly prefilled requests are scattered into free slots
+mid-flight, so the decode batch stays full under mixed lengths. Prompt
+widths are bucketed to multiples of 32 and prefill rows to powers of two
+(the `_bucket_prompts` idiom from base_trainer.py) to bound
+recompilation.
+
+Numerics: masked cache columns carry a -1e9 attention bias whose exp
+underflows to exactly 0.0 in f32, so a row's logits depend only on its
+own valid columns — greedy decode through the slot pool is bit-identical
+to a fresh-batch `trainer.generate` run regardless of pool composition,
+padding width, or which slot the request lands in (pinned by
+tests/test_inference_engine.py).
+
+Thread safety: all device-touching methods are expected to be called
+from ONE driver thread (the scheduler loop); `set_params` may be called
+from any thread (checkpoint hot-reload) and swaps atomically under a
+lock read at each dispatch.
+"""
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu.models.transformer import init_kv_cache
+from trlx_tpu.ops.sampling import GenerationConfig, process_logits, select_token
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def _pow2_bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class InferenceEngine:
+    """Generation over a fixed pool of `num_slots` KV-cache slots.
+
+    :param model: a flax module exposing `decode_step` (prefill) and
+        `decode_step_rows` (per-slot decode) — `CausalLMWithValueHead`
+        and friends.
+    :param gen_cfg: engine-wide sampling knobs. Per-request overrides are
+        limited to `max_new_tokens` (≤ the engine's, which sizes the
+        cache); everything else is fixed at engine build time so the
+        decode program compiles once.
+    """
+
+    def __init__(
+        self,
+        model,
+        model_cfg,
+        params,
+        gen_cfg: GenerationConfig,
+        num_slots: int = 8,
+        max_prompt_len: int = 256,
+        max_prefill_batch: int = 8,
+        prompt_bucket: int = 32,
+        seed: int = 0,
+    ):
+        if getattr(model_cfg, "is_seq2seq", False):
+            raise NotImplementedError(
+                "the continuous-batching engine serves causal LMs only"
+            )
+        if getattr(model_cfg, "prompt_tokens", 0) > 0 or getattr(model_cfg, "prefix_tokens", 0) > 0:
+            raise NotImplementedError(
+                "slot-pool decode under prompt/prefix tuning is unsupported"
+            )
+        if gen_cfg.num_beams > 1:
+            raise NotImplementedError("beam search is not servable slot-wise")
+        if gen_cfg.repetition_penalty != 1.0:
+            raise NotImplementedError(
+                "repetition_penalty requires per-slot seen-token tracking; "
+                "not supported by the inference engine yet"
+            )
+        self.model = model
+        self.model_cfg = model_cfg
+        self.gen_cfg = gen_cfg
+        self.num_slots = int(num_slots)
+        self.prompt_bucket = int(prompt_bucket)
+        self.max_prompt_len = _round_up(int(max_prompt_len), self.prompt_bucket)
+        self.max_prefill_batch = int(max_prefill_batch)
+        self.max_len = self.max_prompt_len + gen_cfg.max_new_tokens
+
+        self._params = params
+        self._param_lock = threading.Lock()
+        self._param_version = 0
+
+        V = model_cfg.vocab_size
+        P = self.num_slots
+        self._suppress = None
+        if gen_cfg.suppress_tokens:
+            m = np.zeros((V,), np.float32)
+            m[np.asarray(gen_cfg.suppress_tokens, np.int64)] = -np.inf
+            self._suppress = jnp.asarray(m)
+
+        cache = init_kv_cache(model_cfg, P, self.max_len)
+        self._pool: Dict[str, Any] = {
+            "layers": cache["layers"],
+            "mask": cache["mask"],
+            "pos": cache["pos"],
+            "row_index": jnp.zeros((P,), jnp.int32),
+            "step": jnp.zeros((P,), jnp.int32),
+            "active": jnp.zeros((P,), jnp.int32),
+            "max_new": jnp.full((P,), gen_cfg.max_new_tokens, jnp.int32),
+            "last_logits": jnp.zeros((P, V), jnp.float32),
+            "rng": jax.random.PRNGKey(seed),
+        }
+        self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
+        self._insert_fns: Dict[int, Callable] = {}
+        self._decode_fn = self._make_decode()
+
+    # ------------------------------------------------------------------
+    # Params (checkpoint hot-reload)
+    # ------------------------------------------------------------------
+
+    def set_params(self, params) -> int:
+        """Atomically swap the served params. In-flight requests continue
+        on the new weights from their next decode step — the KV cache
+        keeps the old prefix's keys/values, exactly like serving a live
+        policy mid-update. Returns the new param version."""
+        with self._param_lock:
+            self._params = params
+            self._param_version += 1
+            return self._param_version
+
+    @property
+    def param_version(self) -> int:
+        return self._param_version
+
+    def _current_params(self):
+        with self._param_lock:
+            return self._params
+
+    # ------------------------------------------------------------------
+    # Prefill + insert
+    # ------------------------------------------------------------------
+
+    def _get_prefill(self, pb: int, plen: int) -> Callable:
+        key = (pb, plen)
+        if key not in self._prefill_fns:
+            model, cfg, S = self.model, self.model_cfg, self.max_len
+
+            def prefill(params, ids, mask):
+                cache = init_kv_cache(cfg, ids.shape[0], S)
+                out = model.apply(
+                    {"params": params}, ids, cache, mask, True,
+                    method=type(model).decode_step,
+                )
+                logits, new_cache = out[0], out[-1]
+                return logits[:, -1].astype(jnp.float32), new_cache
+
+            self._prefill_fns[key] = jax.jit(prefill)
+        return self._prefill_fns[key]
+
+    def _get_insert(self, pb: int) -> Callable:
+        if pb not in self._insert_fns:
+
+            def insert(pool, cache, last_logits, slot_ids, max_new):
+                # slot_ids >= num_slots mark padding rows: out-of-bounds
+                # scatter updates are dropped, so they never land
+                layers = [
+                    {
+                        "k": pl["k"].at[slot_ids].set(cl["k"]),
+                        "v": pl["v"].at[slot_ids].set(cl["v"]),
+                    }
+                    for pl, cl in zip(pool["layers"], cache["layers"])
+                ]
+                row_index = jnp.full(
+                    (last_logits.shape[0],), cache["index"], jnp.int32
+                )
+                return {
+                    **pool,
+                    "layers": layers,
+                    "mask": pool["mask"].at[slot_ids].set(cache["mask"]),
+                    "pos": pool["pos"].at[slot_ids].set(cache["pos"]),
+                    "row_index": pool["row_index"].at[slot_ids].set(row_index),
+                    "step": pool["step"].at[slot_ids].set(0),
+                    "active": pool["active"].at[slot_ids].set(1),
+                    "max_new": pool["max_new"].at[slot_ids].set(max_new),
+                    "last_logits": pool["last_logits"].at[slot_ids].set(last_logits),
+                }
+
+            # donate the old pool (the scatter aliases it); the prefill
+            # cache can't alias (different leading dim), so it isn't listed
+            self._insert_fns[pb] = jax.jit(insert, donate_argnums=(0,))
+        return self._insert_fns[pb]
+
+    def insert_requests(
+        self,
+        rows: Sequence[Tuple[np.ndarray, int]],  # (unpadded prompt ids, max_new)
+        slot_ids: Sequence[int],
+    ) -> None:
+        """Prefill `rows` (length-bucketed, left-padded) and scatter them
+        into the given free slots. Requests are grouped by prompt-width
+        bucket; each group prefills as one jitted call."""
+        assert len(rows) == len(slot_ids)
+        pad_id = self.gen_cfg.pad_token_id
+        groups: Dict[int, List[Tuple[np.ndarray, int, int]]] = {}
+        for (ids, max_new), slot in zip(rows, slot_ids):
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            if ids.size == 0 or ids.size > self.max_prompt_len:
+                raise ValueError(
+                    f"prompt length {ids.size} outside (0, {self.max_prompt_len}]"
+                )
+            if not 0 < max_new <= self.gen_cfg.max_new_tokens:
+                raise ValueError(
+                    f"max_new_tokens {max_new} outside (0, "
+                    f"{self.gen_cfg.max_new_tokens}]"
+                )
+            plen = _round_up(ids.size, self.prompt_bucket)
+            groups.setdefault(plen, []).append((ids, int(max_new), int(slot)))
+
+        params = self._current_params()
+        for plen, members in groups.items():
+            for i in range(0, len(members), self.max_prefill_batch):
+                chunk = members[i : i + self.max_prefill_batch]
+                pb = _pow2_bucket(len(chunk), self.max_prefill_batch)
+                ids_arr = np.full((pb, plen), pad_id, np.int32)
+                mask_arr = np.zeros((pb, plen), np.int32)
+                # padding rows repeat row 0 (a real prompt; fully-masked
+                # rows are avoided) and scatter out of bounds
+                slots_arr = np.full((pb,), self.num_slots, np.int32)
+                max_new_arr = np.full((pb,), self.gen_cfg.max_new_tokens, np.int32)
+                for j, (ids, max_new, slot) in enumerate(chunk):
+                    ids_arr[j, plen - ids.size :] = ids  # left-padded (decode convention)
+                    mask_arr[j, plen - ids.size :] = 1
+                    slots_arr[j] = slot
+                    max_new_arr[j] = max_new
+                ids_arr[len(chunk) :] = ids_arr[0]
+                mask_arr[len(chunk) :] = mask_arr[0]
+
+                last_logits, cache = self._get_prefill(pb, plen)(
+                    params, jnp.asarray(ids_arr), jnp.asarray(mask_arr)
+                )
+                self._pool = self._get_insert(pb)(
+                    self._pool, cache, last_logits,
+                    jnp.asarray(slots_arr), jnp.asarray(max_new_arr),
+                )
+
+    # ------------------------------------------------------------------
+    # Decode
+    # ------------------------------------------------------------------
+
+    def _make_decode(self) -> Callable:
+        model, gen_cfg, suppress = self.model, self.gen_cfg, self._suppress
+        pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
+
+        def decode(params, pool):
+            active = pool["active"].astype(bool)
+            rng, key = jax.random.split(pool["rng"])
+            scores = pool["last_logits"]
+            if suppress is not None:
+                scores = scores + suppress
+            # pool["step"] is per-row, exactly the loop counter each row
+            # would see in the while-loop sampler
+            scores = process_logits(scores, gen_cfg, pool["step"])
+            token = select_token(scores, key, gen_cfg).astype(jnp.int32)
+            token = jnp.where(active, token, pad)
+            valid = active
+            finished = active & (
+                (token == eos) | (pool["step"] + 1 >= pool["max_new"])
+            )
+            cache = {k: pool[k] for k in ("layers", "mask", "pos", "row_index")}
+            logits, new_cache = model.apply(
+                {"params": params}, token[:, None], cache,
+                valid.astype(jnp.int32)[:, None],
+                method=type(model).decode_step_rows,
+            )
+            new_pool = {
+                **pool,
+                **new_cache,
+                "last_logits": logits[:, -1].astype(jnp.float32),
+                "step": pool["step"] + active.astype(jnp.int32),
+                "active": pool["active"] * (1 - finished.astype(jnp.int32)),
+                "rng": rng,
+            }
+            return new_pool, token, valid, finished
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Advance every active slot one token. Returns host arrays
+        (tokens [P], emitted [P] bool, finished [P] bool); finished slots
+        are already deactivated in the pool."""
+        params = self._current_params()
+        self._pool, token, valid, finished = self._decode_fn(params, self._pool)
+        token, valid, finished = jax.device_get((token, valid, finished))
+        return (
+            np.asarray(token),
+            np.asarray(valid).astype(bool),
+            np.asarray(finished).astype(bool),
+        )
+
+    def release_slots(self, slots: Sequence[int]) -> None:
+        """Deactivate slots host-side (deadline cancel / shutdown)."""
+        if not len(slots):
+            return
+        idx = jnp.asarray(np.asarray(slots, np.int32))
+        self._pool = {**self._pool, "active": self._pool["active"].at[idx].set(0)}
+
+    @property
+    def active_slots(self) -> int:
+        return int(np.asarray(self._pool["active"]).sum())

@@ -1,0 +1,105 @@
+"""Dependency-free Prometheus-text metrics for the inference server.
+
+Counters, gauges, and fixed-bucket histograms behind one lock, rendered
+in the Prometheus exposition format by `render()` — enough for a scrape
+target without pulling in prometheus_client. Metric names are
+namespaced `trlx_tpu_inference_*` at render time.
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# log-ish spaced latency buckets: 1ms .. 60s
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+NAMESPACE = "trlx_tpu_inference"
+
+
+class _Histogram:
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # +Inf tail
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, value: float) -> None:
+        for i, edge in enumerate(self.buckets):
+            if value <= edge:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        self.total += value
+        self.n += 1
+
+
+class InferenceMetrics:
+    """Thread-safe metric registry for one server instance."""
+
+    def __init__(self, num_slots: int):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {"slots_total": float(num_slots)}
+        self._hists: Dict[str, _Histogram] = {}
+        # instantaneous throughput: EWMA over decode steps
+        self._tokens_per_s = 0.0
+
+    def inc(self, name: str, by: float = 1.0) -> None:
+        self.add(name, by)
+
+    def add(self, name: str, by: float) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, self._gauges.get(name, 0.0))
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = _Histogram()
+            self._hists[name].observe(value)
+
+    def record_token_rate(self, tokens: int, step_seconds: float, alpha: float = 0.2) -> None:
+        if step_seconds <= 0:
+            return
+        rate = tokens / step_seconds
+        with self._lock:
+            prev = self._tokens_per_s
+            self._tokens_per_s = rate if prev == 0.0 else (1 - alpha) * prev + alpha * rate
+            self._gauges["tokens_per_second"] = self._tokens_per_s
+
+    def render(self) -> str:
+        """Prometheus text exposition."""
+        lines: List[str] = []
+        with self._lock:
+            for name, value in sorted(self._gauges.items()):
+                base = name.split("{")[0]
+                lines.append(f"# TYPE {NAMESPACE}_{base} gauge")
+                lines.append(f"{NAMESPACE}_{name} {value}")
+            seen_types = set()
+            for name, value in sorted(self._counters.items()):
+                base = name.split("{")[0]
+                if base not in seen_types:
+                    seen_types.add(base)
+                    lines.append(f"# TYPE {NAMESPACE}_{base} counter")
+                lines.append(f"{NAMESPACE}_{name} {value}")
+            for name, h in sorted(self._hists.items()):
+                lines.append(f"# TYPE {NAMESPACE}_{name} histogram")
+                cum = 0
+                for edge, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lines.append(f'{NAMESPACE}_{name}_bucket{{le="{edge}"}} {cum}')
+                cum += h.counts[-1]
+                lines.append(f'{NAMESPACE}_{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{NAMESPACE}_{name}_sum {h.total}")
+                lines.append(f"{NAMESPACE}_{name}_count {h.n}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,278 @@
+"""Request scheduling for the continuous-batching engine.
+
+FIFO admission into free KV-cache slots with:
+
+- **bounded queue + explicit backpressure** — `submit` raises
+  `QueueFullError` (the server maps it to HTTP 503 + Retry-After)
+  instead of letting latency grow without bound;
+- **max-wait batching** — when the pool is already busy, admission waits
+  up to `max_wait_s` for more queued requests so prefills batch together
+  (one jitted prefill per bucket instead of one per request); an idle
+  pool admits immediately;
+- **per-request deadlines** — requests expire both in the queue and
+  mid-flight; expired in-flight requests release their slot for the
+  next admission.
+
+The driver loop runs on one daemon thread (JAX dispatch is kept
+single-threaded); HTTP handler threads only touch the queue under the
+condition lock and block on each request's completion event.
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+
+from trlx_tpu.inference.metrics import InferenceMetrics
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+class QueueFullError(RuntimeError):
+    """Queue depth limit hit — back off and retry after `retry_after`s."""
+
+    def __init__(self, depth: int, retry_after: float = 1.0):
+        self.depth = depth
+        self.retry_after = retry_after
+        super().__init__(f"request queue full ({depth} deep)")
+
+
+@dataclass
+class InferenceRequest:
+    id: int
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    deadline: Optional[float]  # absolute time.monotonic()
+    enqueue_time: float = field(default_factory=time.monotonic)
+    token_ids: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None  # eos | length | deadline | shutdown
+    finish_time: Optional[float] = None
+    _done: threading.Event = field(default_factory=threading.Event, repr=False)
+
+    @property
+    def ok(self) -> bool:
+        return self.finish_reason in ("eos", "length")
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_time is None:
+            return None
+        return self.finish_time - self.enqueue_time
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class Scheduler:
+    """Drives an `InferenceEngine`: admit → decode → deliver, forever."""
+
+    def __init__(
+        self,
+        engine,
+        max_queue_depth: int = 64,
+        max_wait_s: float = 0.01,
+        default_deadline_s: Optional[float] = None,
+        metrics: Optional[InferenceMetrics] = None,
+    ):
+        self.engine = engine
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_wait_s = float(max_wait_s)
+        self.default_deadline_s = default_deadline_s
+        self.metrics = metrics or InferenceMetrics(engine.num_slots)
+        self._queue: Deque[InferenceRequest] = deque()
+        self._cond = threading.Condition()
+        self._slot_req: Dict[int, InferenceRequest] = {}
+        self._free: List[int] = list(range(engine.num_slots))
+        self._ids = itertools.count()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # Client surface (any thread)
+    # ------------------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> InferenceRequest:
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        if ids.size > self.engine.max_prompt_len:
+            raise ValueError(
+                f"prompt length {ids.size} exceeds max_prompt_len "
+                f"{self.engine.max_prompt_len}"
+            )
+        max_new = int(max_new_tokens or self.engine.gen_cfg.max_new_tokens)
+        if not 0 < max_new <= self.engine.gen_cfg.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new} outside (0, "
+                f"{self.engine.gen_cfg.max_new_tokens}]"
+            )
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        req = InferenceRequest(
+            id=next(self._ids),
+            prompt_ids=ids,
+            max_new_tokens=max_new,
+            deadline=(time.monotonic() + dl) if dl else None,
+        )
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            if len(self._queue) >= self.max_queue_depth:
+                self.metrics.inc("requests_rejected_total")
+                # rough drain estimate: one queued generation ahead of us
+                # per free wave of the pool
+                waves = max(1, len(self._queue) // max(self.engine.num_slots, 1))
+                raise QueueFullError(len(self._queue), retry_after=float(waves))
+            self._queue.append(req)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify_all()
+        return req
+
+    def generate(self, prompt_ids, max_new_tokens=None, deadline_s=None,
+                 timeout: Optional[float] = None) -> InferenceRequest:
+        """Blocking submit + wait convenience (tests, in-process callers)."""
+        req = self.submit(prompt_ids, max_new_tokens, deadline_s)
+        req.wait(timeout)
+        return req
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="trlx-tpu-inference-scheduler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        # fail whatever is left so no caller blocks forever
+        with self._cond:
+            leftovers = list(self._queue) + list(self._slot_req.values())
+            self._queue.clear()
+        self.engine.release_slots(list(self._slot_req))
+        for req in leftovers:
+            req.finish_reason = "shutdown"
+            req.finish_time = time.monotonic()
+            req._done.set()
+        self._slot_req.clear()
+        self._free = list(range(self.engine.num_slots))
+
+    # ------------------------------------------------------------------
+    # Driver loop (one thread)
+    # ------------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                if not self._queue and not self._slot_req:
+                    self._cond.wait(timeout=0.05)
+                    continue
+            try:
+                self._expire_queued()
+                self._admit()
+                if self._slot_req:
+                    self._decode_once()
+            except Exception:  # pragma: no cover - defensive: keep serving
+                logger.exception("inference scheduler step failed")
+                time.sleep(0.05)
+
+    def _expire_queued(self) -> None:
+        now = time.monotonic()
+        expired = []
+        with self._cond:
+            alive: Deque[InferenceRequest] = deque()
+            for req in self._queue:
+                (expired if req.deadline and now > req.deadline else alive).append(req)
+            if expired:
+                self._queue = alive
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+        for req in expired:
+            self._finish_request(req, "deadline")
+
+    def _admit(self) -> None:
+        with self._cond:
+            if not self._queue or not self._free:
+                return
+            want = min(len(self._free), self.engine.max_prefill_batch)
+            oldest_wait = time.monotonic() - self._queue[0].enqueue_time
+            if (
+                self._slot_req  # pool busy: decoding continues regardless,
+                and len(self._queue) < want  # so wait a beat to batch the
+                and oldest_wait < self.max_wait_s  # prefills together
+            ):
+                return
+            batch, slots = [], []
+            while self._queue and self._free:
+                batch.append(self._queue.popleft())
+                slots.append(self._free.pop())
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+        t0 = time.perf_counter()
+        self.engine.insert_requests(
+            [(r.prompt_ids, r.max_new_tokens) for r in batch], slots
+        )
+        self.metrics.observe("prefill_latency_seconds", time.perf_counter() - t0)
+        self.metrics.inc("prefill_batches_total")
+        with self._cond:
+            for req, slot in zip(batch, slots):
+                self._slot_req[slot] = req
+            self.metrics.set_gauge("slots_active", len(self._slot_req))
+
+    def _decode_once(self) -> None:
+        t0 = time.perf_counter()
+        tokens, valid, finished = self.engine.step()
+        dt = time.perf_counter() - t0
+        self.metrics.observe("decode_step_latency_seconds", dt)
+        emitted = 0
+        now = time.monotonic()
+        eos = self.engine.gen_cfg.eos_token_id
+        for slot, req in list(self._slot_req.items()):
+            if valid[slot]:
+                req.token_ids.append(int(tokens[slot]))
+                emitted += 1
+            if finished[slot]:
+                reason = "eos" if int(tokens[slot]) == eos else "length"
+                self._release(slot)
+                self._finish_request(req, reason)
+            elif req.deadline and now > req.deadline:
+                self.engine.release_slots([slot])
+                self._release(slot)
+                self._finish_request(req, "deadline")
+        self.metrics.add("tokens_generated_total", emitted)
+        self.metrics.record_token_rate(emitted, dt)
+
+    def _release(self, slot: int) -> None:
+        with self._cond:
+            self._slot_req.pop(slot, None)
+            self._free.append(slot)
+            self.metrics.set_gauge("slots_active", len(self._slot_req))
+
+    def _finish_request(self, req: InferenceRequest, reason: str) -> None:
+        req.finish_reason = reason
+        req.finish_time = time.monotonic()
+        self.metrics.inc(f'requests_total{{outcome="{reason}"}}')
+        if req.latency_s is not None:
+            self.metrics.observe("request_latency_seconds", req.latency_s)
+        req._done.set()

@@ -1,0 +1,312 @@
+"""Policy inference server: HTTP front-end over the continuous-batching
+engine, with Prometheus metrics and checkpoint hot-reload.
+
+Follows the `RewardModelServer` pattern (trlx_tpu/serving.py): a
+dependency-free `ThreadingHTTPServer`, JSON in/out, and an optional
+`resilience.FaultInjector` for deterministic failure tests.
+
+Endpoints:
+
+- ``POST /generate`` — ``{"prompt": str}`` or ``{"prompt_ids": [...]}``
+  plus optional ``max_new_tokens`` / ``deadline_s``. Answers
+  ``{"id", "text", "token_ids", "finish_reason", "latency_s"}``.
+  Backpressure: a full queue answers **503 with a Retry-After header**
+  (the shared HTTP client retries those transparently); an expired
+  deadline answers **504**.
+- ``GET /healthz`` — liveness + slot/queue/reload snapshot.
+- ``GET /metrics`` — Prometheus text: queue depth, slot occupancy,
+  prefill/decode/request latency histograms, tokens/sec.
+
+Hot-reload: with `watch_dir` set, a daemon thread polls for the newest
+**manifest-complete** checkpoint (PR 1's `resilience` validation — a
+half-written checkpoint is never loaded) and atomically swaps the new
+params into the engine; in-flight requests keep their KV cache and
+continue on the new weights at their next decode step.
+"""
+
+import ast
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from trlx_tpu import resilience
+from trlx_tpu.inference.scheduler import QueueFullError, Scheduler
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+
+def load_checkpoint_params(directory: str) -> Dict:
+    """Restore the merged policy param tree from a trainer checkpoint
+    (`TPUTrainer.save` layout: orbax `state/` holding flat tuple-keyed
+    `train_params` + `frozen_params`). Orbax renders tuple keys as their
+    string repr, so keys are literal_eval'd back and the two partitions
+    unflattened into one nested tree. Optimizer state is ignored."""
+    import orbax.checkpoint as ocp
+    from flax import traverse_util
+
+    raw = ocp.PyTreeCheckpointer().restore(os.path.join(directory, "state"))
+    flat: Dict[tuple, Any] = {}
+    for part in ("train_params", "frozen_params"):
+        for k, v in (raw.get(part) or {}).items():
+            key = ast.literal_eval(k) if isinstance(k, str) and k.startswith("(") else (k,)
+            flat[tuple(key)] = v
+    if not flat:
+        raise ValueError(f"checkpoint at {directory} holds no policy params")
+    return traverse_util.unflatten_dict(flat)
+
+
+class CheckpointWatcher(threading.Thread):
+    """Poll `watch_dir` for newer manifest-complete checkpoints and swap
+    them into the engine. Truncated/mid-write checkpoints are invisible
+    (no manifest), so a swap is always a complete state."""
+
+    def __init__(self, engine, watch_dir: str, interval_s: float = 5.0,
+                 metrics=None, loader=load_checkpoint_params):
+        super().__init__(name="trlx-tpu-ckpt-watcher", daemon=True)
+        self.engine = engine
+        self.watch_dir = watch_dir
+        self.interval_s = interval_s
+        self.metrics = metrics
+        self.loader = loader
+        self.loaded_step: Optional[int] = None
+        self.loaded_path: Optional[str] = None
+        self._loaded_key = None  # (path, step, wall_time) of the live params
+        self.reloads = 0
+        self._stop = threading.Event()
+
+    def poll_once(self) -> bool:
+        """One scan; returns True if a new checkpoint was swapped in."""
+        path = resilience.find_latest_valid_checkpoint(self.watch_dir)
+        if path is None:
+            return False
+        manifest = resilience.read_manifest(path) or {}
+        step = int(manifest.get("step", -1))
+        # key on (path, step, wall_time): a re-promotion into the SAME
+        # directory name (atomic dir swap) is still picked up
+        key = (path, step, manifest.get("wall_time"))
+        if key == self._loaded_key:
+            return False
+        try:
+            params = self.loader(path)
+        except Exception as e:
+            logger.warning(f"hot-reload: failed to load {path}: {e}")
+            return False
+        self.engine.set_params(params)
+        self.loaded_step, self.loaded_path = step, path
+        self._loaded_key = key
+        self.reloads += 1
+        if self.metrics is not None:
+            self.metrics.inc("checkpoint_reloads_total")
+            self.metrics.set_gauge("checkpoint_step", step)
+        logger.info(f"hot-reload: serving checkpoint {path} (step {step})")
+        return True
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # pragma: no cover - keep watching
+                logger.exception("checkpoint watcher scan failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class InferenceServer:
+    """Serve a `Scheduler` (and its engine) over HTTP."""
+
+    def __init__(
+        self,
+        scheduler: Scheduler,
+        tokenizer=None,
+        host: str = "0.0.0.0",
+        port: int = 8600,
+        watch_dir: Optional[str] = None,
+        reload_interval_s: float = 5.0,
+        fault_injector: Optional["resilience.FaultInjector"] = None,
+        checkpoint_loader=load_checkpoint_params,
+    ):
+        self.scheduler = scheduler
+        self.engine = scheduler.engine
+        self.metrics = scheduler.metrics
+        self.tokenizer = tokenizer
+        self.host = host
+        self.port = port
+        self.fault_injector = fault_injector
+        self.watcher: Optional[CheckpointWatcher] = None
+        if watch_dir:
+            self.watcher = CheckpointWatcher(
+                self.engine, watch_dir, reload_interval_s, self.metrics,
+                loader=checkpoint_loader,
+            )
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    def _handle_generate(self, payload: Dict) -> Dict:
+        if "prompt_ids" in payload:
+            ids = np.asarray(payload["prompt_ids"], np.int32).reshape(-1)
+        elif "prompt" in payload:
+            if self.tokenizer is None:
+                raise ValueError("server has no tokenizer; send prompt_ids")
+            ids = np.asarray(
+                self.tokenizer.encode(str(payload["prompt"])), np.int32
+            )[-self.engine.max_prompt_len :]
+        else:
+            raise ValueError("payload needs 'prompt' or 'prompt_ids'")
+        unsupported = set(payload) - {
+            "prompt", "prompt_ids", "max_new_tokens", "deadline_s"
+        }
+        if unsupported:
+            raise ValueError(
+                f"unsupported request keys {sorted(unsupported)}; sampling "
+                "knobs are fixed at server start (inference.gen_kwargs)"
+            )
+        req = self.scheduler.submit(
+            ids,
+            max_new_tokens=payload.get("max_new_tokens"),
+            deadline_s=payload.get("deadline_s"),
+        )
+        req.wait()
+        out = {
+            "id": req.id,
+            "token_ids": req.token_ids,
+            "finish_reason": req.finish_reason,
+            "latency_s": req.latency_s,
+        }
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(req.token_ids)
+        return out
+
+    def _make_handler(self):
+        server = self  # live reference: tests can swap fault_injector mid-run
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: bytes, content_type: str = "application/json",
+                       headers: Optional[Dict[str, str]] = None):
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, obj: Dict, headers=None):
+                self._reply(code, json.dumps(obj).encode(), headers=headers)
+
+            def do_POST(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/generate"):
+                    self.send_error(404)
+                    return
+                injector = server.fault_injector
+                if injector is not None and injector.should_fail():
+                    mode = injector.mode
+                    if mode == "mixed":
+                        mode = "drop" if injector.injected % 2 else "http_500"
+                    if mode == "drop":
+                        self.close_connection = True
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
+                    self._reply_json(503, {"error": "injected transient failure"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    result = server._handle_generate(payload)
+                except QueueFullError as e:
+                    self._reply_json(
+                        503,
+                        {"error": "queue full, retry later", "queue_depth": e.depth},
+                        headers={"Retry-After": str(max(1, int(e.retry_after)))},
+                    )
+                    return
+                except (ValueError, TypeError) as e:
+                    self._reply_json(400, {"error": str(e)})
+                    return
+                except Exception as e:  # surface engine errors to the client
+                    self._reply_json(500, {"error": repr(e)})
+                    return
+                if result["finish_reason"] == "deadline":
+                    self._reply_json(504, {"error": "deadline exceeded", **result})
+                elif result["finish_reason"] == "shutdown":
+                    self._reply_json(503, {"error": "server shutting down"})
+                else:
+                    self._reply_json(200, result)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.rstrip("/")
+                if path == "/metrics":
+                    self._reply(
+                        200, server.metrics.render().encode(),
+                        content_type="text/plain; version=0.0.4",
+                    )
+                    return
+                if path in ("", "/healthz"):
+                    watcher = server.watcher
+                    self._reply_json(200, {
+                        "status": "ok",
+                        "slots_total": server.engine.num_slots,
+                        "slots_active": server.engine.active_slots,
+                        "queue_depth": int(server.metrics.get("queue_depth")),
+                        "param_version": server.engine.param_version,
+                        "checkpoint_step": watcher.loaded_step if watcher else None,
+                        "reloads": watcher.reloads if watcher else 0,
+                    })
+                    return
+                self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                logger.debug("inference-server: " + fmt % args)
+
+        return Handler
+
+    # ------------------------------------------------------------------
+
+    def _bind(self) -> None:
+        self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self.scheduler.start()
+        if self.watcher is not None:
+            self.watcher.start()
+
+    @property
+    def url(self) -> str:
+        host = "127.0.0.1" if self.host == "0.0.0.0" else self.host
+        return f"http://{host}:{self.port}"
+
+    def start_background(self) -> str:
+        """Start serving on a daemon thread; returns the base URL."""
+        self._bind()
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        logger.info(f"Inference server listening on {self.url}")
+        return self.url
+
+    def serve(self) -> None:
+        """Blocking serve (the standalone policy-server process)."""
+        self._bind()
+        logger.info(f"Inference server listening on :{self.port}")
+        try:
+            self._httpd.serve_forever()
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        if self.watcher is not None:
+            self.watcher.stop()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self.scheduler.stop()

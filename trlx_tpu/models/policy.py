@@ -136,6 +136,11 @@ class CausalLMWithValueHead(nn.Module):
             return logits, self.v_head(h)[..., 0], new_cache
         return logits, None, new_cache
 
+    def decode_step_rows(self, tokens, cache, token_mask):
+        """Per-row-offset cached decode (continuous-batching slot pool,
+        trlx_tpu/inference/engine.py). Returns (logits, new_cache)."""
+        return self.lm.decode_step_rows(tokens, cache, token_mask)
+
 
 class CausalLMWithILQLHeads(nn.Module):
     cfg: TransformerConfig
@@ -158,6 +163,12 @@ class CausalLMWithILQLHeads(nn.Module):
         logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
         qs, target_qs, vs = self.ilql_heads(h)
         return logits, qs, target_qs, vs, new_cache
+
+    def decode_step_rows(self, tokens, cache, token_mask):
+        """Per-row-offset cached decode (continuous-batching slot pool).
+        Plain-LM logits only — the ILQL advantage shift is a training-time
+        sampler feature; serve ILQL policies with the static engine."""
+        return self.lm.decode_step_rows(tokens, cache, token_mask)
 
 
 # ---------------------------------------------------------------------------

@@ -281,9 +281,22 @@ class Attention(nn.Module):
         new_cache = None
         if layer_cache is not None:
             # Write this step's K/V into the cache at cache_index, then attend
-            # over the whole (static-length) cache.
-            ck = jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, cache_index, 0, 0))
-            cv = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
+            # over the whole (static-length) cache. cache_index is a scalar
+            # (every row at the same decode depth — the training sampler) or
+            # a [b] vector of per-row offsets (the continuous-batching slot
+            # pool, trlx_tpu/inference/engine.py, where each slot sits at
+            # its own depth).
+            kc = k.astype(layer_cache["k"].dtype)
+            vc = v.astype(layer_cache["v"].dtype)
+            if jnp.ndim(cache_index) == 1:
+                row_update = jax.vmap(
+                    lambda c, x, i: jax.lax.dynamic_update_slice(c, x, (i, 0, 0))
+                )
+                ck = row_update(layer_cache["k"], kc, cache_index)
+                cv = row_update(layer_cache["v"], vc, cache_index)
+            else:
+                ck = jax.lax.dynamic_update_slice(layer_cache["k"], kc, (0, cache_index, 0, 0))
+                cv = jax.lax.dynamic_update_slice(layer_cache["v"], vc, (0, cache_index, 0, 0))
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv}
 
@@ -780,6 +793,52 @@ class TransformerLM(nn.Module):
             "layers": new_layers,
         }
         return logits, h, new_cache
+
+    def decode_step_rows(
+        self,
+        tokens: jnp.ndarray,  # [b, 1]
+        cache: Dict[str, Any],
+        token_mask: jnp.ndarray,  # [b, 1] validity (0 = free/inactive slot)
+    ):
+        """One cached decode step where every row carries its OWN write
+        offset (`cache["row_index"]`, [b]) — the continuous-batching slot
+        pool (trlx_tpu/inference/engine.py). Rows sit at different decode
+        depths, so the shared scalar `index` of `decode_step` cannot
+        express the cache write; per-row offsets can, and for a live row
+        the computation is bit-identical to `decode_step` on an aligned
+        batch (masked cache columns contribute exactly zero). Inactive
+        rows (token_mask 0) write a 0 into the mask at their current
+        column — a value-level no-op — and do not advance. Returns
+        (logits, new_cache)."""
+        if self.cfg.prompt_tokens > 0 or self.cfg.prefix_tokens > 0:
+            raise NotImplementedError(
+                "slot-pool decode under prompt/prefix tuning is unsupported"
+            )
+        b, _ = tokens.shape
+        row_index = cache["row_index"]
+        positions = cache["pos"][:, None]
+        step_valid = token_mask[:, 0].astype(jnp.int32)
+        new_mask = cache["mask"].at[jnp.arange(b), row_index].set(
+            token_mask[:, 0].astype(cache["mask"].dtype)
+        )
+        bias = decode_bias(new_mask, 1)
+        if self.cfg.alibi:
+            bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
+        if self.cfg.sliding_window is not None:
+            bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
+        h = self.embed(tokens, positions)
+        h, new_layers = self.run_blocks(
+            h, bias, positions, 0, self.cfg.n_layers,
+            cache=cache["layers"], cache_index=row_index,
+        )
+        logits, _ = self.unembed(h)
+        new_cache = {
+            "row_index": row_index + step_valid,
+            "mask": new_mask,
+            "pos": cache["pos"] + step_valid,
+            "layers": new_layers,
+        }
+        return logits, new_cache
 
 
 def position_ids(attn_mask: jnp.ndarray) -> jnp.ndarray:

@@ -105,6 +105,18 @@ def process_logits(
     return logits
 
 
+def select_token(scores: jnp.ndarray, key, cfg: GenerationConfig) -> jnp.ndarray:
+    """Pick next tokens from processed scores [b, V]: categorical sampling
+    under do_sample (temperature 0 degrades to greedy, like HF), argmax
+    otherwise. The ONE token-selection rule shared by the while-loop
+    sampler below and the continuous-batching inference engine
+    (trlx_tpu/inference/engine.py) — keeping greedy decode bit-identical
+    between them."""
+    if cfg.do_sample and cfg.temperature != 0.0:
+        return jax.random.categorical(key, scores, axis=-1)
+    return jnp.argmax(scores, axis=-1)
+
+
 def topp_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     """Nucleus mask: keep tokens until cumulative prob exceeds p (always
     keeping the top-1), set the rest to -inf. Shared by the sampling loop
@@ -227,11 +239,7 @@ def make_generate_fn(
             rng, key = jax.random.split(rng)
             scores = shift_logits(logits, adv, prev_token)
             scores = process_logits(scores, gen_cfg, i, seen if track_seen else None)
-            if gen_cfg.do_sample and gen_cfg.temperature != 0.0:
-                token = jax.random.categorical(key, scores, axis=-1)
-            else:
-                token = jnp.argmax(scores, axis=-1)
-            token = token.astype(token_dtype)
+            token = select_token(scores, key, gen_cfg).astype(token_dtype)
             token = jnp.where(finished, gen_cfg.pad_token_id, token)
             valid = (~finished).astype(jnp.int32)
             finished = finished | (token == gen_cfg.eos_token_id)

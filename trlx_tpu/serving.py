@@ -162,99 +162,58 @@ def remote_reward_fn(
     triton client round, ppo_hh.py:112-130). Optional client-side
     batching for large rollout chunks.
 
-    Fault tolerance (trlx_tpu/resilience.py): transient failures —
-    connection drops, timeouts, HTTP 5xx — are retried with exponential
-    backoff + jitter instead of killing the PPO run; scoring errors
-    raised by the reward_fn itself (HTTP 500 with an ``error`` payload
-    from user code, 4xx) stay fatal. After `breaker_threshold`
-    consecutive transport failures the circuit breaker opens and calls
-    fail fast for `breaker_recovery` seconds; with `fallback_to_mean`
-    an open breaker degrades to the running mean of previously returned
-    scores (zero before any success) so a rollout batch still completes
-    while the reward server restarts.
+    Fault tolerance: the transport sits on the shared retry/circuit-
+    breaker HTTP stack (`trlx_tpu.utils.http.RetryingJSONClient`, also
+    under `remote_generate`) — transient failures (connection drops,
+    timeouts, HTTP 502/503/504) are retried with exponential backoff +
+    jitter instead of killing the PPO run; scoring errors raised by the
+    reward_fn itself (HTTP 500 with an ``error`` payload from user code,
+    4xx) stay fatal. After `breaker_threshold` consecutive transport
+    failures the circuit breaker opens and calls fail fast for
+    `breaker_recovery` seconds; with `fallback_to_mean` an open breaker
+    degrades to the running mean of previously returned scores (zero
+    before any success) so a rollout batch still completes while the
+    reward server restarts.
     """
-    import http.client
-    import urllib.request
+    from trlx_tpu.utils.http import RetryingJSONClient
 
-    url = url.rstrip("/") + "/score"
-    breaker = resilience.CircuitBreaker(
-        failure_threshold=breaker_threshold, recovery_time=breaker_recovery
+    client = RetryingJSONClient(
+        url.rstrip("/") + "/score",
+        timeout=timeout,
+        retries=retries,
+        retry_base_delay=retry_base_delay,
+        retry_max_delay=retry_max_delay,
+        retry_max_elapsed=retry_max_elapsed,
+        breaker_threshold=breaker_threshold,
+        breaker_recovery=breaker_recovery,
+        error_label="reward server",
+        _sleep=_sleep,
     )
     # running mean of every scalar score successfully returned, for the
     # degrade path once the breaker opens
     score_stats = {"sum": 0.0, "count": 0}
 
-    def raw_call(payload: dict) -> List:
-        import urllib.error
-
-        req = urllib.request.Request(
-            url, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
+    def cached_mean(n: int, why: str) -> List:
+        mean = score_stats["sum"] / max(score_stats["count"], 1)
+        logger.warning_once(
+            f"{why}: degrading to cached mean score ({mean:.4f}) until the "
+            "reward server recovers"
         )
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                out = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            if e.code >= 500:
-                try:
-                    detail = json.loads(e.read()).get("error", str(e))
-                except Exception:
-                    detail = str(e)
-                if "injected transient" in str(detail) or e.code in (502, 503, 504):
-                    raise resilience.TransientError(
-                        f"reward server {e.code}: {detail}"
-                    ) from e
-                raise RuntimeError(f"reward server error: {detail}") from e
-            raise RuntimeError(f"reward server error: {e}") from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
-            raise resilience.TransientError(f"reward server unreachable: {e}") from e
-        except http.client.HTTPException as e:
-            # dropped connection mid-response (RemoteDisconnected,
-            # IncompleteRead, BadStatusLine) — transport-level, retryable
-            raise resilience.TransientError(f"reward server dropped connection: {e}") from e
-        except json.JSONDecodeError as e:
-            # truncated body from a dying server — retryable
-            raise resilience.TransientError(f"reward server short read: {e}") from e
-        if "error" in out:
-            raise RuntimeError(f"reward server error: {out['error']}")
-        return out["scores"]
-
-    retry_kwargs = dict(
-        retries=retries,
-        base_delay=retry_base_delay,
-        max_delay=retry_max_delay,
-        max_elapsed=retry_max_elapsed,
-        retry_on=(resilience.TransientError,),
-    )
-    if _sleep is not None:  # deterministic tests inject a fake sleep
-        retry_kwargs["sleep"] = _sleep
-    retried_call = resilience.retry(**retry_kwargs)(raw_call)
+        return [mean] * n
 
     def call(payload: dict) -> List:
         try:
-            breaker.check()
+            scores = client.post(payload)["scores"]
         except resilience.CircuitOpenError:
             if not fallback_to_mean:
                 raise
-            mean = score_stats["sum"] / max(score_stats["count"], 1)
-            logger.warning_once(
-                "Reward-server circuit open: degrading to cached mean score "
-                f"({mean:.4f}) until the server recovers"
-            )
-            return [mean] * len(payload["samples"])
-        try:
-            scores = retried_call(payload)
+            return cached_mean(len(payload["samples"]), "Reward-server circuit open")
         except resilience.TransientError:
-            breaker.record_failure()
-            if fallback_to_mean and breaker.state != "closed":
-                mean = score_stats["sum"] / max(score_stats["count"], 1)
-                logger.warning_once(
-                    "Reward server unreachable after retries: degrading to "
-                    f"cached mean score ({mean:.4f})"
+            if fallback_to_mean and client.breaker.state != "closed":
+                return cached_mean(
+                    len(payload["samples"]), "Reward server unreachable after retries"
                 )
-                return [mean] * len(payload["samples"])
             raise
-        breaker.record_success()
         for s in scores:
             if np.ndim(s) == 0:
                 score_stats["sum"] += float(s)

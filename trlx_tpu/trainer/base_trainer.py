@@ -385,6 +385,63 @@ class TPUTrainer(BaseRLTrainer):
         return str_samples, str_prompts, str_outputs
 
     # ------------------------------------------------------------------
+    # Serving (trlx_tpu/inference/): expose the policy as a service
+    # ------------------------------------------------------------------
+
+    def serve(self, host: Optional[str] = None, port: Optional[int] = None,
+              watch_dir: Optional[str] = None, background: bool = False):
+        """Serve the current policy through the continuous-batching
+        inference server (config section: `inference`). Generation knobs
+        come from the method's gen_kwargs overlaid with
+        `inference.gen_kwargs`; `inference.max_new_tokens` caps the
+        per-request budget and sizes the KV slot pool.
+
+        With `watch_dir` (or `inference.watch_dir`) the server hot-reloads
+        the newest manifest-complete checkpoint from a live training run.
+        `background=True` starts a daemon thread and returns the
+        `InferenceServer` (its `.url` is the base endpoint); otherwise
+        this blocks serving forever."""
+        from trlx_tpu.inference import InferenceEngine, InferenceServer, Scheduler
+        from trlx_tpu.ops.sampling import GenerationConfig
+
+        icfg = self.config.inference
+        gen_kwargs = {**self.generate_kwargs, **(icfg.gen_kwargs or {})}
+        gen_kwargs.setdefault("max_new_tokens", icfg.max_new_tokens)
+        gen_kwargs["max_new_tokens"] = min(
+            int(gen_kwargs["max_new_tokens"]), icfg.max_new_tokens
+        )
+        gen_cfg = GenerationConfig.from_gen_kwargs(
+            gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
+        )
+        engine = InferenceEngine(
+            self.model, self.model_cfg, self.params, gen_cfg,
+            num_slots=icfg.num_slots,
+            max_prompt_len=icfg.max_prompt_len,
+            max_prefill_batch=icfg.max_prefill_batch,
+            prompt_bucket=icfg.prompt_bucket,
+            seed=self.config.train.seed,
+        )
+        scheduler = Scheduler(
+            engine,
+            max_queue_depth=icfg.max_queue_depth,
+            max_wait_s=icfg.max_wait_s,
+            default_deadline_s=icfg.default_deadline_s,
+        )
+        server = InferenceServer(
+            scheduler,
+            tokenizer=self.tokenizer,
+            host=host if host is not None else icfg.host,
+            port=port if port is not None else icfg.port,
+            watch_dir=watch_dir if watch_dir is not None else icfg.watch_dir,
+            reload_interval_s=icfg.reload_interval_s,
+        )
+        if background:
+            server.start_background()
+            return server
+        server.serve()
+        return server
+
+    # ------------------------------------------------------------------
     # Train step (jit) with gradient accumulation
     # ------------------------------------------------------------------
 

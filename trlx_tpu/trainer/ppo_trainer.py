@@ -373,6 +373,12 @@ class PPOTrainer(TPUTrainer):
             clock.tick()  # reset timer
             samples = np.asarray(out["samples"])  # materialize (also syncs device)
             stats["time/rollout_generate"] = clock.tick()
+            # throughput over REAL generated tokens (the validity mask —
+            # padding after eos doesn't count); tick() returns ms
+            gen_s = max(stats["time/rollout_generate"] / 1000.0, 1e-9)
+            real_tokens = int(np.asarray(out["response_mask"]).sum())
+            stats["throughput/rollout_tokens_per_s"] = real_tokens / gen_s
+            stats["throughput/rollout_requests_per_s"] = n_this / gen_s
 
             prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
                 self._host_process_chunk(batch, samples, stats, clock)

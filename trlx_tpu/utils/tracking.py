@@ -44,16 +44,35 @@ class JSONLTracker(Tracker):
         # truncate: one file per run (matches the config.json overwrite);
         # appending across reruns would interleave restarted _step sequences
         self._fh = open(self.path, "w")
+        self._dropped: Dict[str, str] = {}
+        self._meta_path = os.path.splitext(self.path)[0] + ".meta.json"
 
     def log(self, stats: Dict[str, Any], step: int):
         row = {"_step": step, "_time": time.time()}
+        dropped = {}
         for k, v in stats.items():
+            if isinstance(v, bool):
+                row[k] = int(v)  # 0/1, not a dropped key
+                continue
             try:
                 row[k] = float(v)
             except (TypeError, ValueError):
-                continue
+                dropped[k] = type(v).__name__
+        if dropped:
+            self._record_dropped(dropped)
         self._fh.write(json.dumps(row) + "\n")
         self._fh.flush()
+
+    def _record_dropped(self, dropped: Dict[str, str]):
+        """Non-numeric stats can't go on a curve; instead of discarding
+        them silently, record each dropped key (with its type) once in a
+        `.meta.json` sidecar next to the metrics file."""
+        new = {k: t for k, t in dropped.items() if k not in self._dropped}
+        if not new:
+            return
+        self._dropped.update(new)
+        with open(self._meta_path, "w") as f:
+            json.dump({"dropped_keys": self._dropped}, f, indent=2, sort_keys=True)
 
     def finish(self):
         self._fh.close()

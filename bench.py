@@ -66,6 +66,7 @@ JSON line (seq 8192 SFT fwd+bwd) goes to stderr afterwards.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -91,6 +92,22 @@ PEAK_FLOPS = [
 N_PROMPT = 64
 
 
+def _proc_start_ticks(pid):
+    """Kernel start time (clock ticks since boot) of `pid` from
+    /proc/<pid>/stat field 22, or None if the process is gone. A (pid,
+    starttime) pair identifies a process instance even after the pid is
+    recycled — a bare kill(pid, 0) aliveness probe cannot."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            data = f.read()
+        # the comm field (2) can contain spaces/parens; split after the
+        # LAST ")" so fields 3+ index cleanly. starttime is field 22,
+        # i.e. index 19 past state (field 3).
+        return int(data.rsplit(") ", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
 def chip_peak_flops() -> float:
     import jax
 
@@ -101,15 +118,31 @@ def chip_peak_flops() -> float:
     return 197e12  # unknown TPU: assume v5e-class
 
 
-def build_trainer(smoke: bool = False):
+def fast_rollout_requested(argv) -> bool:
+    """`method.capture_rollout_stats=true` (or `--fast-rollout`) on the
+    command line turns on the rollout fast path: in-loop logprob/value
+    capture + windowed reference suffix + cross-cycle overlap."""
+    return any(
+        a.replace(" ", "") in ("method.capture_rollout_stats=true",
+                               "--fast-rollout")
+        for a in argv
+    )
+
+
+def build_trainer(smoke: bool = False, fast: bool = False):
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
     from trlx_tpu.trainer.ppo_trainer import PPOTrainer
 
     config = default_ppo_config()
+    if fast:
+        config = config.evolve(method=dict(capture_rollout_stats=True))
     if smoke:
+        # num_layers_unfrozen 1 (not the default 2): gpt2-tiny has two
+        # blocks, and a 2-of-2 split leaves no frozen suffix — which
+        # would silently gate off the rollout fast path in smoke runs
         config = config.evolve(
-            model=dict(model_path="random:gpt2-tiny"),
+            model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1),
             train=dict(seq_length=128, batch_size=8),
             method=dict(num_rollouts=16, chunk_size=16,
                         gen_kwargs=dict(max_new_tokens=8)),
@@ -192,7 +225,8 @@ def run_cycle(trainer, config):
 
 
 def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
-                    unfrozen) -> dict:
+                    unfrozen, window_ok: bool = True,
+                    fast_path: bool = False) -> dict:
     """Itemized FLOP estimate for one PPO cycle (documented approximations;
     used only for the MFU estimate, never for vs_baseline).
 
@@ -219,16 +253,26 @@ def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
 
     # generation: prefill the prompt, then n_new cached decode steps
     gen = fwd(n_prompt, n_prompt / 2) + fwd(n_new, n_prompt + n_new / 2)
-    # scoring: full policy+value fwd, plus the in-graph frozen-reference
-    # branch re-running the top `unfrozen` blocks + lm_head
-    score = fwd(T, T / 2) + fwd(T, T / 2, layers=unfrozen)
-    # one train step (r5 windowed head, ppo_trainer forward_window): the
-    # trunk runs full-width fwd + dX/dW over the unfrozen top, but the
-    # 2·d·V unembedding (fwd + dX) only covers the n_new response
-    # positions the loss reads — full-width head FLOPs would no longer be
-    # work the step performs
-    train = (fwd(T, T / 2, with_head=False) + n_new * head
-             + fwd(T, T / 2, layers=unfrozen, with_head=False) + n_new * head
+    if fast_path:
+        # fast rollout path: policy logprobs + values were captured inside
+        # the sampling loop (already counted under gen), so score is ONLY
+        # the frozen-reference suffix resumed from the captured split
+        # activations, with the unembedding windowed to the n_new response
+        # positions the KL reads
+        score = fwd(T, T / 2, layers=unfrozen, with_head=False) + n_new * head
+    else:
+        # scoring: full policy+value fwd, plus the in-graph frozen-reference
+        # branch re-running the top `unfrozen` blocks + lm_head
+        score = fwd(T, T / 2) + fwd(T, T / 2, layers=unfrozen)
+    # one train step: the trunk runs full-width fwd + dX/dW over the
+    # unfrozen top. When the r5 windowed head applies (ppo_trainer
+    # forward_window — no MoE, no deeper value branch, no soft prompt),
+    # the 2·d·V unembedding (fwd + dX) only covers the n_new response
+    # positions the loss reads; otherwise the step really computes the
+    # full-width head and the estimate must charge all T positions.
+    head_tokens = n_new if window_ok else T
+    train = (fwd(T, T / 2, with_head=False) + head_tokens * head
+             + fwd(T, T / 2, layers=unfrozen, with_head=False) + head_tokens * head
              + fwd(T, T / 2, layers=unfrozen, with_head=False))
     per_sample = gen + score + ppo_epochs * train
     return {
@@ -311,6 +355,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     np.asarray(zero * one)  # compile + warm
     rtt, _ = timed(lambda: zero * one, lambda x: x, n=5)
 
+    fast = trainer._fast_rollout_available()
     times = {}
     t, (batch, out) = timed(
         lambda: trainer.dispatch_rollout_generation(),
@@ -319,7 +364,14 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     times["generate"] = max(t - rtt, 1e-9)
 
     spec = None
-    if trainer._spec_path_available():
+    if fast:
+        # fast path: the generation above already captured in-loop policy
+        # logprobs/values, so score = the frozen-ref windowed suffix only
+        t, spec = timed(
+            lambda: trainer._dispatch_fast_score(out), lambda s: s[4]
+        )
+        times["score"] = max(t - rtt, 1e-9)
+    elif trainer._spec_path_available():
         t, spec = timed(
             lambda: trainer._dispatch_spec_score(out), lambda s: s[4]
         )
@@ -344,18 +396,40 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
             spec[1], spec[2], spec[3],
             jnp.asarray(scores_eff), jnp.float32(trainer.kl_ctl.value),
         )
-        np.asarray(chunk.rewards[0, 0])
-        t, _ = timed(
-            lambda: trainer.train_epochs_from_chunk(chunk, method.ppo_epochs),
-            lambda st: st["losses"]["total_loss"],
+    else:
+        # no speculative/fast scorer (e.g. retokenization round trip not
+        # identity): build the chunk via the classic fused score+reward
+        # program, timing it as this configuration's real "score" phase,
+        # so times["train"] below is measured in EVERY configuration
+        fns = getattr(trainer, "_score_reward_fns", None) or {}
+        trainer._score_reward_fns = fns
+        if True not in fns:
+            fns[True] = trainer._build_score_reward_fn(True)
+        t, chunk = timed(
+            lambda: fns[True](
+                trainer.train_params, trainer.frozen_params,
+                trainer.ref_params, jnp.asarray(prompt_tensors),
+                jnp.asarray(sample_outputs), jnp.asarray(scores_eff),
+                jnp.float32(trainer.kl_ctl.value),
+            ),
+            lambda r: r[0].rewards[0, 0],
         )
-        times["train"] = max(t - rtt, 1e-9)
+        times["score"] = max(t - rtt, 1e-9)
+        chunk = chunk[0]
+    np.asarray(chunk.rewards[0, 0])
+    t, _ = timed(
+        lambda: trainer.train_epochs_from_chunk(chunk, method.ppo_epochs),
+        lambda st: st["losses"]["total_loss"],
+    )
+    times["train"] = max(t - rtt, 1e-9)
 
     phase_mfu = {
         k: round(flops[k] / times[k] / n_chips / peak, 4)
         for k in ("generate", "score", "train") if k in times
     }
-    return times, phase_mfu, rtt
+    schedule = ("fast_overlap" if fast
+                else "spec_overlap" if spec is not None else "classic")
+    return times, phase_mfu, rtt, schedule
 
 
 def main():
@@ -368,7 +442,6 @@ def main():
         # fresh process with a warm compile cache. The headline JSON
         # reaches stdout first either way, so a driver timeout can only
         # cost the (stderr) long-context line.
-        import os
         import subprocess
 
         rc = subprocess.call(
@@ -401,15 +474,35 @@ def main():
             # single-instance guard: a second bench run while the seeder is
             # still compiling must NOT spawn another one (device contention
             # would skew the next timed window — the longctx line became a
-            # sequential subprocess for exactly that reason)
-            lock = "/tmp/trlx_tpu_longctx_seed.pid"
+            # sequential subprocess for exactly that reason). The lock is
+            # an O_CREAT|O_EXCL file recording "pid starttime": the
+            # exclusive create closes the check-then-spawn race between two
+            # concurrent first runs, and the /proc starttime comparison
+            # closes the recycled-PID hole a bare kill(pid, 0) aliveness
+            # probe leaves open (a new unrelated process on the old pid
+            # would keep reading as "seeder alive" forever).
+            lock = "/tmp/trlx_tpu_longctx_seed.lock"
+            fd = None
             seeding = False
-            if os.path.exists(lock):
+            for _ in range(5):
                 try:
-                    os.kill(int(open(lock).read().strip()), 0)
-                    seeding = True  # seeder alive
-                except (OSError, ValueError):
-                    os.unlink(lock)
+                    fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+                    break  # we own the lock
+                except FileExistsError:
+                    try:
+                        pid_s, start_s = open(lock).read().split()
+                        alive = _proc_start_ticks(int(pid_s)) == int(start_s)
+                    except (OSError, ValueError):
+                        alive = False  # unreadable/partial lock: stale
+                    if alive:
+                        seeding = True
+                        break
+                    try:
+                        os.unlink(lock)  # stale: remove and retry the create
+                    except OSError:
+                        pass
+            else:
+                seeding = True  # contention exhausted retries: assume seeding
             if seeding:
                 sys.stderr.write(
                     "[bench] longctx line skipped: cold XLA compile cache; "
@@ -430,8 +523,11 @@ def main():
                         stdout=seedlog, stderr=seedlog,
                         start_new_session=True,
                     )
-                with open(lock, "w") as f:
-                    f.write(str(proc.pid))
+                with os.fdopen(fd, "w") as f:
+                    f.write(f"{proc.pid} {_proc_start_ticks(proc.pid) or 0}")
+                fd = None
+            if fd is not None:
+                os.close(fd)
         sys.exit(rc)
     t0 = time.time()
 
@@ -454,7 +550,8 @@ def main():
         )
 
     classic = "--classic" in sys.argv
-    trainer, config = build_trainer(smoke)
+    fast = fast_rollout_requested(sys.argv[1:])
+    trainer, config = build_trainer(smoke, fast=fast)
     n_chips = max(jax.device_count(), 1)
 
     # >=100 cycles / >=45s: r3's 21-cycle/10.6s window was small enough
@@ -497,9 +594,13 @@ def main():
     sps_chip = samples / elapsed / n_chips
     tps_chip = tokens / elapsed / n_chips
 
+    window_ok = (trainer._window_loss_ok()
+                 and getattr(trainer.model_cfg, "moe_experts", 0) == 0)
     flops = flops_per_cycle(
         trainer.model_cfg, n_prompt, n_new, config.method.num_rollouts,
         config.method.ppo_epochs, config.model.num_layers_unfrozen,
+        window_ok=window_ok,
+        fast_path=(not classic) and trainer._fast_rollout_available(),
     )
     mfu = flops["total"] * cycles / elapsed / n_chips / chip_peak_flops()
 
@@ -507,7 +608,9 @@ def main():
     phase_json = {}
     if not classic:
         try:
-            times, phase_mfu, rtt = measure_phases(trainer, config, flops, n_chips)
+            times, phase_mfu, rtt, schedule = measure_phases(
+                trainer, config, flops, n_chips
+            )
             cycle_wall = elapsed / cycles
             device_busy = sum(times.get(k, 0.0) for k in ("generate", "score", "train"))
             phase_json = {
@@ -515,9 +618,11 @@ def main():
                 "phase_mfu": phase_mfu,
                 "relay_rtt_seconds": round(rtt, 4),
                 "overlap_efficiency": round(device_busy / cycle_wall, 3),
+                "schedule": schedule,
             }
             sys.stderr.write(
-                "[bench] phase device-times (RTT-corrected, min of 3): "
+                f"[bench] phase device-times ({schedule} schedule, "
+                "RTT-corrected, min of 3): "
                 + " | ".join(
                     f"{k} {times[k]*1e3:.0f}ms"
                     + (f" (MFU {phase_mfu[k]:.3f})" if k in phase_mfu else "")

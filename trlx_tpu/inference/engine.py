@@ -41,7 +41,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.models.transformer import init_kv_cache
-from trlx_tpu.ops.sampling import GenerationConfig, process_logits, select_token
+from trlx_tpu.ops.sampling import (
+    GenerationConfig,
+    process_logits,
+    sampled_token_logprob,
+    select_token,
+)
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -119,6 +124,12 @@ class InferenceEngine:
             self._suppress = jnp.asarray(m)
 
         cache = init_kv_cache(model_cfg, P, self.max_len)
+        # Fused sampling: the pool carries each slot's PRE-SAMPLED next
+        # token + its policy logprob instead of a [P, V] f32 logits bank —
+        # suppress/warping/categorical draw happen inside the same jitted
+        # program that produced the logits (insert or decode), so no
+        # [P, vocab] array round-trips through the pool per token and the
+        # sampling work no longer sits outside the fused decode step.
         self._pool: Dict[str, Any] = {
             "layers": cache["layers"],
             "mask": cache["mask"],
@@ -127,7 +138,8 @@ class InferenceEngine:
             "step": jnp.zeros((P,), jnp.int32),
             "active": jnp.zeros((P,), jnp.int32),
             "max_new": jnp.full((P,), gen_cfg.max_new_tokens, jnp.int32),
-            "last_logits": jnp.zeros((P, V), jnp.float32),
+            "next_token": jnp.full((P,), gen_cfg.pad_token_id, jnp.int32),
+            "next_logprob": jnp.zeros((P,), jnp.float32),
             "rng": jax.random.PRNGKey(seed),
         }
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
@@ -157,6 +169,24 @@ class InferenceEngine:
             return self._params
 
     # ------------------------------------------------------------------
+    # Fused sampling (traced inside the insert / decode programs)
+    # ------------------------------------------------------------------
+
+    def _sample_fused(self, raw_logits, key, step):
+        """Shared warp + draw: suppress -> process_logits -> select_token
+        over the RAW f32 logits, returning (token int32, policy logprob
+        f32). Identical op order to the while-loop sampler's per-step
+        block, so greedy decode through the pool stays bit-identical to
+        `trainer.generate`; the logprob reads the raw (pre-warp) logits —
+        the true policy probability, like the rollout fast path."""
+        scores = raw_logits
+        if self._suppress is not None:
+            scores = scores + self._suppress
+        scores = process_logits(scores, self.gen_cfg, step)
+        token = select_token(scores, key, self.gen_cfg).astype(jnp.int32)
+        return token, sampled_token_logprob(raw_logits, token)
+
+    # ------------------------------------------------------------------
     # Prefill + insert
     # ------------------------------------------------------------------
 
@@ -179,6 +209,7 @@ class InferenceEngine:
 
     def _get_insert(self, pb: int) -> Callable:
         if pb not in self._insert_fns:
+            sample_fused = self._sample_fused
 
             def insert(pool, cache, last_logits, slot_ids, max_new):
                 # slot_ids >= num_slots mark padding rows: out-of-bounds
@@ -193,6 +224,12 @@ class InferenceEngine:
                 row_index = jnp.full(
                     (last_logits.shape[0],), cache["index"], jnp.int32
                 )
+                # each fresh request's FIRST token samples here, fused with
+                # the scatter (step 0 = the while-loop sampler's first
+                # iteration); padding rows draw garbage that the OOB
+                # scatter drops
+                rng, key = jax.random.split(pool["rng"])
+                token, lp = sample_fused(last_logits, key, 0)
                 return {
                     **pool,
                     "layers": layers,
@@ -202,7 +239,9 @@ class InferenceEngine:
                     "step": pool["step"].at[slot_ids].set(0),
                     "active": pool["active"].at[slot_ids].set(1),
                     "max_new": pool["max_new"].at[slot_ids].set(max_new),
-                    "last_logits": pool["last_logits"].at[slot_ids].set(last_logits),
+                    "next_token": pool["next_token"].at[slot_ids].set(token),
+                    "next_logprob": pool["next_logprob"].at[slot_ids].set(lp),
+                    "rng": rng,
                 }
 
             # donate the old pool (the scatter aliases it); the prefill
@@ -267,20 +306,17 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _make_decode(self) -> Callable:
-        model, gen_cfg, suppress = self.model, self.gen_cfg, self._suppress
+        model, gen_cfg = self.model, self.gen_cfg
         pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
+        sample_fused = self._sample_fused
 
         def decode(params, pool):
             active = pool["active"].astype(bool)
-            rng, key = jax.random.split(pool["rng"])
-            scores = pool["last_logits"]
-            if suppress is not None:
-                scores = scores + suppress
-            # pool["step"] is per-row, exactly the loop counter each row
-            # would see in the while-loop sampler
-            scores = process_logits(scores, gen_cfg, pool["step"])
-            token = select_token(scores, key, gen_cfg).astype(jnp.int32)
-            token = jnp.where(active, token, pad)
+            # emit the token the PREVIOUS program (insert or decode)
+            # already sampled — no warping work on this side of the model
+            # call, and no [P, V] logits carried between programs
+            token = jnp.where(active, pool["next_token"], pad)
+            logprob = pool["next_logprob"]
             valid = active
             finished = active & (
                 (token == eos) | (pool["step"] + 1 >= pool["max_new"])
@@ -291,27 +327,38 @@ class InferenceEngine:
                 valid.astype(jnp.int32)[:, None],
                 method=type(model).decode_step_rows,
             )
+            # fused draw of each row's NEXT token from the fresh logits;
+            # new_step is per-row, exactly the loop counter each row would
+            # see in the while-loop sampler (finished/inactive rows draw
+            # garbage that is never emitted — insert overwrites the slot)
+            rng, key = jax.random.split(pool["rng"])
+            new_step = pool["step"] + active.astype(jnp.int32)
+            nxt, nxt_lp = sample_fused(logits[:, -1].astype(jnp.float32), key, new_step)
             new_pool = {
                 **pool,
                 **new_cache,
-                "last_logits": logits[:, -1].astype(jnp.float32),
-                "step": pool["step"] + active.astype(jnp.int32),
+                "next_token": nxt,
+                "next_logprob": nxt_lp,
+                "step": new_step,
                 "active": pool["active"] * (1 - finished.astype(jnp.int32)),
                 "rng": rng,
             }
-            return new_pool, token, valid, finished
+            return new_pool, token, logprob, valid, finished
 
         return jax.jit(decode, donate_argnums=(1,))
 
-    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Advance every active slot one token. Returns host arrays
-        (tokens [P], emitted [P] bool, finished [P] bool); finished slots
-        are already deactivated in the pool."""
+        (tokens [P], logprobs [P] f32, emitted [P] bool, finished [P]
+        bool); finished slots are already deactivated in the pool. The
+        logprob is the policy's raw-logit log-probability of the emitted
+        token (see `_sample_fused`), meaningful only where `emitted`."""
         params = self._current_params()
-        self._pool, token, valid, finished = self._decode_fn(params, self._pool)
-        token, valid, finished = jax.device_get((token, valid, finished))
+        self._pool, token, logprob, valid, finished = self._decode_fn(params, self._pool)
+        token, logprob, valid, finished = jax.device_get((token, logprob, valid, finished))
         return (
             np.asarray(token),
+            np.asarray(logprob, np.float32),
             np.asarray(valid).astype(bool),
             np.asarray(finished).astype(bool),
         )

@@ -50,6 +50,9 @@ class InferenceRequest:
     deadline: Optional[float]  # absolute time.monotonic()
     enqueue_time: float = field(default_factory=time.monotonic)
     token_ids: List[int] = field(default_factory=list)
+    # per-token policy logprobs (raw-logit log-softmax at each emitted
+    # token), filled alongside token_ids by the fused decode step
+    token_logprobs: List[float] = field(default_factory=list)
     finish_reason: Optional[str] = None  # eos | length | deadline | shutdown
     finish_time: Optional[float] = None
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
@@ -242,7 +245,7 @@ class Scheduler:
 
     def _decode_once(self) -> None:
         t0 = time.perf_counter()
-        tokens, valid, finished = self.engine.step()
+        tokens, logprobs, valid, finished = self.engine.step()
         dt = time.perf_counter() - t0
         self.metrics.observe("decode_step_latency_seconds", dt)
         emitted = 0
@@ -251,6 +254,7 @@ class Scheduler:
         for slot, req in list(self._slot_req.items()):
             if valid[slot]:
                 req.token_ids.append(int(tokens[slot]))
+                req.token_logprobs.append(float(logprobs[slot]))
                 emitted += 1
             if finished[slot]:
                 reason = "eos" if int(tokens[slot]) == eos else "length"

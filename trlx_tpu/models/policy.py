@@ -117,6 +117,15 @@ class CausalLMWithValueHead(nn.Module):
         """Frozen-branch pass from the split point (apply with ref params)."""
         return self.lm.forward_from(h_split, attn_mask, positions, start_layer)
 
+    def forward_ref_suffix_window(self, h_split, attn_mask, positions=None,
+                                  start_layer: int = 0, start: int = 0, length: int = 1):
+        """Frozen-branch pass from the split point, unembedding only
+        positions [start, start+length) — the score phase of the rollout
+        fast path, where the sampler already captured h_split and only the
+        response window of the reference logits is needed."""
+        return self.lm.forward_from_window(h_split, attn_mask, positions, start_layer,
+                                           start, length)
+
     def forward_ref_full(self, tokens, attn_mask, positions=None):
         """Full reference forward (used when every layer is trainable).
         Skips the soft prompt under prompt tuning — the reference likewise
@@ -125,16 +134,29 @@ class CausalLMWithValueHead(nn.Module):
         logits, _, _ = self.lm(tokens, attn_mask, positions, 0, use_prompt=False)
         return logits
 
-    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False, with_value: bool = False):
-        logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False,
+                    with_value: bool = False, capture_split=None):
+        """Cached decode. `capture_split` (rollout fast path) additionally
+        returns the activation entering that block, making the return a
+        4-tuple (logits, values, cache, h_cap)."""
+        if capture_split is not None:
+            logits, h, new_cache, h_cap = self.lm.decode_step(
+                tokens, cache, token_mask, is_prefill, capture_split
+            )
+        else:
+            logits, h, new_cache = self.lm.decode_step(tokens, cache, token_mask, is_prefill)
+            h_cap = None
+        values = None
         if with_value:
             if self.num_value_layers > 0:
                 raise NotImplementedError(
                     "per-step values during decode are not supported with a "
                     "value branch (values are computed in the scoring pass)"
                 )
-            return logits, self.v_head(h)[..., 0], new_cache
-        return logits, None, new_cache
+            values = self.v_head(h)[..., 0]
+        if capture_split is not None:
+            return logits, values, new_cache, h_cap
+        return logits, values, new_cache
 
     def decode_step_rows(self, tokens, cache, token_mask):
         """Per-row-offset cached decode (continuous-batching slot pool,

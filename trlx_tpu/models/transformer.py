@@ -731,21 +731,56 @@ class TransformerLM(nn.Module):
         logits, _ = self.unembed(h)
         return logits
 
+    def forward_from_window(
+        self,
+        h: jnp.ndarray,
+        attn_mask: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        start_layer: int = 0,
+        start: int = 0,
+        length: int = 1,
+    ) -> jnp.ndarray:
+        """`forward_from` with the windowed unembedding of
+        `forward_window`: run blocks [start_layer, n_layers) over the full
+        width, then final norm + head over positions [start, start+length)
+        only. The rollout fast path reads just the response window of the
+        frozen-reference logits, and the 2·d·V head matmul dominates the
+        suffix at bench shapes."""
+        if positions is None:
+            positions = self._default_positions(h, attn_mask)
+        bias = self._train_bias(attn_mask)
+        h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers,
+                               attn_mask=attn_mask)
+        hw = jax.lax.dynamic_slice_in_dim(h, start, length, axis=1)
+        logits, _ = self.unembed(hw)
+        return logits
+
     def decode_step(
         self,
         tokens: jnp.ndarray,  # [b, t] (prefill) or [b, 1] (step)
         cache: Dict[str, Any],
         token_mask: jnp.ndarray,  # [b, t] validity of these tokens
         is_prefill: bool = False,
+        capture_split: Optional[int] = None,
     ):
         """One cached decode call. The cache pytree carries:
         index (scalar write offset), mask [b, S], pos [b] (next position id
         per row), layers (per-layer k/v). Under prompt tuning the prefill
         prepends the soft prompt into the cache (init_kv_cache reserves the
-        extra slots); logits keep the caller's sequence length."""
+        extra slots); logits keep the caller's sequence length.
+
+        `capture_split` (rollout fast path) splits the block run at that
+        layer and additionally returns the activation ENTERING it — the
+        same hydra split point as __call__'s h_split — making the return a
+        4-tuple (logits, h_final, new_cache, h_cap)."""
         b, t = tokens.shape
         index = cache["index"]
         P = self.cfg.prompt_tokens if is_prefill else 0
+        if capture_split is not None and self.cfg.prompt_tokens > 0:
+            raise NotImplementedError(
+                "split-activation capture under prompt tuning is unsupported "
+                "(the soft prompt widens the captured rows)"
+            )
         if P > 0:
             token_mask = jnp.concatenate(
                 [jnp.ones((b, P), token_mask.dtype), token_mask], axis=1
@@ -782,9 +817,26 @@ class TransformerLM(nn.Module):
             )
         else:
             h = self.embed(tokens, positions)
-        h, new_layers = self.run_blocks(
-            h, bias, positions, 0, self.cfg.n_layers, cache=cache["layers"], cache_index=index
-        )
+        if capture_split is None:
+            h_cap = None
+            h, new_layers = self.run_blocks(
+                h, bias, positions, 0, self.cfg.n_layers, cache=cache["layers"],
+                cache_index=index
+            )
+        else:
+            # split the block run so the activation entering block
+            # `capture_split` comes out; cache layer indices are absolute,
+            # so concatenating the two halves' new layers is exact
+            h, low = self.run_blocks(
+                h, bias, positions, 0, capture_split, cache=cache["layers"],
+                cache_index=index
+            )
+            h_cap = h
+            h, high = self.run_blocks(
+                h, bias, positions, capture_split, self.cfg.n_layers,
+                cache=cache["layers"], cache_index=index
+            )
+            new_layers = low + high
         logits, h = self.unembed(h[:, P:] if P > 0 else h)
         new_cache = {
             "index": index + t_ext,
@@ -792,6 +844,8 @@ class TransformerLM(nn.Module):
             "pos": next_pos,
             "layers": new_layers,
         }
+        if capture_split is not None:
+            return logits, h, new_cache, h_cap
         return logits, h, new_cache
 
     def decode_step_rows(

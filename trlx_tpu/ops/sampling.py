@@ -117,6 +117,18 @@ def select_token(scores: jnp.ndarray, key, cfg: GenerationConfig) -> jnp.ndarray
     return jnp.argmax(scores, axis=-1)
 
 
+def sampled_token_logprob(raw_logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
+    """Policy logprob of the chosen token, read off the RAW (pre-shift,
+    pre-warper) f32 logits [b, V] — the same quantity
+    `logprobs_of_labels` extracts from the batched scoring forward at
+    that position. Shared by the rollout fast path
+    (method.capture_rollout_stats) and the inference engine's fused
+    decode step so both report true policy logprobs regardless of
+    temperature/top-k/suppress warping."""
+    lp = jax.nn.log_softmax(raw_logits, axis=-1)
+    return jnp.take_along_axis(lp, token[:, None].astype(jnp.int32), axis=-1)[:, 0]
+
+
 def topp_mask(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     """Nucleus mask: keep tokens until cumulative prob exceeds p (always
     keeping the top-1), set the rest to -inf. Shared by the sampling loop
@@ -136,6 +148,8 @@ def make_generate_fn(
     mode: str = "lm",  # "lm" | "ilql"
     logit_mask: Optional[np.ndarray] = None,  # [V, V] True = forbidden transition
     two_qs: bool = True,
+    capture: bool = False,
+    capture_split: int = 0,
 ) -> Callable:
     """Build a jittable generate(params, input_ids, attn_mask, rng) ->
     dict(samples, response_tokens, response_mask). Shapes are static per
@@ -144,7 +158,23 @@ def make_generate_fn(
     Covers both architectures: causal (prefill the prompt into the KV
     cache, continue) and seq2seq (encode the prompt once, decode from
     `decoder_start_token_id` with cross-attention — reference T5 generate
-    path via HF, plus ILQL seq2seq generation modeling_ilql.py:481-667)."""
+    path via HF, plus ILQL seq2seq generation modeling_ilql.py:481-667).
+
+    With `capture` on (rollout fast path, method.capture_rollout_stats)
+    the output dict additionally carries the stats PPO scoring would
+    otherwise recompute with a full batched forward:
+
+    - "logprobs"  [b, max_new] f32 — policy logprob of each sampled token
+      (raw-logit log-softmax, i.e. what logprobs_of_labels reads at the
+      same positions);
+    - "values"    [b, max_new] f32 — value head at each token's INPUT
+      position (v(x_{<t}), matching `values[:, :-1]` window semantics of
+      the batched scorer);
+    - "h_split"   [b, plen + max_new, d] — activation entering block
+      `capture_split`, so the frozen-reference branch can resume from the
+      hydra split (forward_ref_suffix) without re-running shared layers.
+
+    Single-beam causal LM only."""
     max_new = gen_cfg.max_new_tokens
     forbid = jnp.asarray(logit_mask) if logit_mask is not None else None
     suppress = None
@@ -155,6 +185,12 @@ def make_generate_fn(
         m[np.asarray(gen_cfg.suppress_tokens, np.int64)] = -np.inf
         suppress = jnp.asarray(m)
     is_seq2seq = bool(getattr(model_cfg, "is_seq2seq", False))
+
+    if capture and (mode != "lm" or is_seq2seq or gen_cfg.num_beams > 1):
+        raise NotImplementedError(
+            "rollout stat capture supports single-beam causal LM "
+            "generation only (no ILQL, seq2seq, or beam search)"
+        )
 
     if gen_cfg.num_beams > 1:
         if mode != "lm" or logit_mask is not None or gen_cfg.suppress_tokens:
@@ -184,6 +220,8 @@ def make_generate_fn(
         return make_beam_generate_fn(model, model_cfg, gen_cfg)
 
     def step_model(params, tokens, cache, token_mask, is_prefill):
+        """One model step -> (last_logits f32 [b, V], ilql adv | None,
+        value | None [b] f32, h_split | None [b, t, d], cache)."""
         if mode == "ilql":
             logits, qs, target_qs, vs, cache = model.apply(
                 {"params": params}, tokens, cache, token_mask, is_prefill,
@@ -194,12 +232,25 @@ def make_generate_fn(
             else:
                 q = target_qs[0][:, -1, :]
             adv = q - vs[:, -1, :]  # [b, V]
-            return logits[:, -1].astype(jnp.float32), adv, cache
+            return logits[:, -1].astype(jnp.float32), adv, None, None, cache
+        if capture:
+            logits, values, cache, h_split = model.apply(
+                {"params": params}, tokens, cache, token_mask, is_prefill,
+                with_value=True, capture_split=capture_split,
+                method=type(model).decode_step,
+            )
+            return (
+                logits[:, -1].astype(jnp.float32),
+                None,
+                values[:, -1].astype(jnp.float32),
+                h_split,
+                cache,
+            )
         logits, _, cache = model.apply(
             {"params": params}, tokens, cache, token_mask, is_prefill,
             method=type(model).decode_step,
         )
-        return logits[:, -1].astype(jnp.float32), None, cache
+        return logits[:, -1].astype(jnp.float32), None, None, None, cache
 
     def shift_logits(logits, adv, prev_token):
         """Mode-specific logit rewrite before sampling."""
@@ -213,8 +264,22 @@ def make_generate_fn(
             logits = jax.nn.log_softmax(logits, axis=-1) + gen_cfg.beta * adv
         return logits
 
-    def decode_loop(rng, cache, last_logits, last_adv, prev_token0, params, b, token_dtype,
-                    seen0=None):
+    def decode_loop(rng, cache, last_logits, last_adv, last_value, prev_token0, params, b,
+                    token_dtype, seen0=None, hs0=None):
+        """Fused sampling loop. Token 0 is drawn here from the prefill
+        logits, OUTSIDE the while_loop, so the carry holds the previous
+        TOKEN (int32 [b]) instead of a [b, V] f32 logits bank, and each
+        body iteration runs model-step -> shift/warp -> draw as one fused
+        block — no per-token [b, vocab] round-trip through the carry, and
+        no trailing model call whose logits are thrown away when the
+        budget runs out. RNG split order and per-step logit math are
+        unchanged, so sampled tokens are bit-identical to the previous
+        structure.
+
+        Under `capture` the carry additionally accumulates each sampled
+        token's raw-logit policy logprob, the value head at its input
+        position, and the split-point activations (`hs0` arrives with the
+        prefill's prompt rows already written)."""
         if last_adv is None:
             last_adv = jnp.zeros((b, 1), dtype=jnp.float32)
         track_seen = gen_cfg.repetition_penalty != 1.0
@@ -225,17 +290,8 @@ def make_generate_fn(
         if not track_seen:
             # dummy 1-wide when unused so the while_loop carry stays tiny
             seen0 = jnp.zeros((b, 1), dtype=bool)
-        out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=token_dtype)
-        out_mask0 = jnp.zeros((b, max_new), dtype=jnp.int32)
-        finished0 = jnp.zeros((b,), dtype=bool)
-        state = (0, rng, cache, last_logits, last_adv, prev_token0, out_tokens0, out_mask0,
-                 finished0, seen0)
 
-        def cond(state):
-            return (state[0] < max_new) & ~jnp.all(state[8])
-
-        def body(state):
-            i, rng, cache, logits, adv, prev_token, out_tokens, out_mask, finished, seen = state
+        def sample(rng, logits, adv, prev_token, finished, seen, i):
             rng, key = jax.random.split(rng)
             scores = shift_logits(logits, adv, prev_token)
             scores = process_logits(scores, gen_cfg, i, seen if track_seen else None)
@@ -245,23 +301,61 @@ def make_generate_fn(
             finished = finished | (token == gen_cfg.eos_token_id)
             if track_seen:
                 seen = seen.at[jnp.arange(b), token].set(True)
+            return rng, token, valid, finished, seen
 
+        finished0 = jnp.zeros((b,), dtype=bool)
+        rng, token0, valid0, finished0, seen0 = sample(
+            rng, last_logits, last_adv, prev_token0, finished0, seen0, 0
+        )
+        out_tokens0 = jnp.full((b, max_new), gen_cfg.pad_token_id, dtype=token_dtype)
+        out_tokens0 = out_tokens0.at[:, 0].set(token0)
+        out_mask0 = jnp.zeros((b, max_new), dtype=jnp.int32).at[:, 0].set(valid0)
+        if capture:
+            lp0 = jnp.zeros((b, max_new), jnp.float32).at[:, 0].set(
+                sampled_token_logprob(last_logits, token0)
+            )
+            v0 = jnp.zeros((b, max_new), jnp.float32).at[:, 0].set(last_value)
+            cap0 = (lp0, v0, hs0)
+        else:
+            cap0 = ()
+        state = (1, rng, cache, token0, valid0, finished0, out_tokens0, out_mask0,
+                 seen0, cap0)
+
+        def cond(state):
+            return (state[0] < max_new) & ~jnp.all(state[5])
+
+        def body(state):
+            i, rng, cache, prev_token, prev_valid, finished, out_tokens, out_mask, seen, cap = state
+            logits, adv, value, h_cap, cache = step_model(
+                params, prev_token[:, None], cache, prev_valid[:, None], False
+            )
+            rng, token, valid, finished, seen = sample(rng, logits, adv, prev_token, finished,
+                                                       seen, i)
             out_tokens = jax.lax.dynamic_update_slice(out_tokens, token[:, None], (0, i))
             out_mask = jax.lax.dynamic_update_slice(out_mask, valid[:, None], (0, i))
-
-            logits, adv, cache = step_model(params, token[:, None], cache, valid[:, None], False)
-            if adv is None:
-                adv = jnp.zeros((b, 1), dtype=jnp.float32)
-            return (i + 1, rng, cache, logits, adv, token, out_tokens, out_mask, finished, seen)
+            if capture:
+                lp_buf, v_buf, hs_buf = cap
+                lp_buf = jax.lax.dynamic_update_slice(
+                    lp_buf, sampled_token_logprob(logits, token)[:, None], (0, i)
+                )
+                v_buf = jax.lax.dynamic_update_slice(v_buf, value[:, None], (0, i))
+                # h_cap is the split activation at prev_token's position
+                # q + i - 1 (q = prompt width baked into hs_buf)
+                hs_off = hs_buf.shape[1] - max_new
+                hs_buf = jax.lax.dynamic_update_slice(hs_buf, h_cap, (0, hs_off + i - 1, 0))
+                cap = (lp_buf, v_buf, hs_buf)
+            return (i + 1, rng, cache, token, valid, finished, out_tokens, out_mask, seen, cap)
 
         final = jax.lax.while_loop(cond, body, state)
-        return final[6], final[7]
+        return final[6], final[7], final[9]
 
     def generate(params, input_ids, attn_mask, rng):
         b, plen = input_ids.shape
         total = plen + max_new
         cache = init_kv_cache(model_cfg, b, total)
-        last_logits, last_adv, cache = step_model(params, input_ids, cache, attn_mask, True)
+        last_logits, last_adv, last_value, h_cap, cache = step_model(
+            params, input_ids, cache, attn_mask, True
+        )
         seen0 = None
         if gen_cfg.repetition_penalty != 1.0:
             # HF semantics: the penalty covers prompt tokens too
@@ -270,18 +364,29 @@ def make_generate_fn(
                 attn_mask.astype(jnp.int32)
             )
             seen0 = counts > 0
-        out_tokens, out_mask = decode_loop(
-            rng, cache, last_logits, last_adv, input_ids[:, -1], params, b, input_ids.dtype,
-            seen0,
+        hs0 = None
+        if capture:
+            # split activations over the full [prompt + response] width:
+            # prefill fills the prompt rows, the loop writes one row per
+            # model step (the final sampled token's row is never written
+            # — it is only ever a masked key / padding query downstream)
+            hs0 = jnp.zeros((b, total, h_cap.shape[-1]), h_cap.dtype)
+            hs0 = jax.lax.dynamic_update_slice(hs0, h_cap, (0, 0, 0))
+        out_tokens, out_mask, cap = decode_loop(
+            rng, cache, last_logits, last_adv, last_value, input_ids[:, -1], params, b,
+            input_ids.dtype, seen0, hs0,
         )
         samples = jnp.concatenate([input_ids, out_tokens], axis=1)
         samples_mask = jnp.concatenate([attn_mask.astype(jnp.int32), out_mask], axis=1)
-        return {
+        out = {
             "samples": samples,
             "samples_mask": samples_mask,
             "response_tokens": out_tokens,
             "response_mask": out_mask,
         }
+        if capture:
+            out["logprobs"], out["values"], out["h_split"] = cap
+        return out
 
     def generate_seq2seq(params, input_ids, attn_mask, rng):
         """Encoder runs once; the decoder starts from decoder_start_token
@@ -299,15 +404,15 @@ def make_generate_fn(
         )
         start = jnp.full((b, 1), start_id, dtype=input_ids.dtype)
         ones = jnp.ones((b, 1), dtype=jnp.int32)
-        last_logits, last_adv, cache = step_model(params, start, cache, ones, True)
+        last_logits, last_adv, _, _, cache = step_model(params, start, cache, ones, True)
         seen0 = None
         if gen_cfg.repetition_penalty != 1.0:
             # decoder-side tokens only (HF penalizes decoder input_ids)
             seen0 = jnp.zeros((b, model_cfg.vocab_size), bool).at[
                 jnp.arange(b), start_id
             ].set(True)
-        out_tokens, out_mask = decode_loop(
-            rng, cache, last_logits, last_adv, start[:, 0], params, b, input_ids.dtype,
+        out_tokens, out_mask, _ = decode_loop(
+            rng, cache, last_logits, last_adv, None, start[:, 0], params, b, input_ids.dtype,
             seen0,
         )
         samples = jnp.concatenate([start, out_tokens], axis=1)
@@ -333,7 +438,10 @@ def generate(
     mode: str = "lm",
     logit_mask=None,
     two_qs: bool = True,
+    capture: bool = False,
+    capture_split: int = 0,
 ):
     """One-shot convenience wrapper (not cached across shapes)."""
-    fn = make_generate_fn(model, model_cfg, gen_cfg, mode, logit_mask, two_qs)
+    fn = make_generate_fn(model, model_cfg, gen_cfg, mode, logit_mask, two_qs,
+                          capture=capture, capture_split=capture_split)
     return fn(params, jnp.asarray(input_ids), jnp.asarray(attn_mask), rng)

@@ -264,13 +264,17 @@ class TPUTrainer(BaseRLTrainer):
         # which this design doesn't have.
         return key
 
-    def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm"):
-        """Jit-cached generate fn per (shape, kwargs) bucket."""
+    def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm",
+                        capture: bool = False):
+        """Jit-cached generate fn per (shape, kwargs) bucket. `capture`
+        builds the rollout fast-path sampler, which additionally emits
+        per-token logprobs/values and the hydra-split activations (see
+        ops/sampling.py)."""
         from trlx_tpu.ops.sampling import GenerationConfig, make_generate_fn
 
         # repr-normalize values: gen_kwargs may carry unhashable HF-style
         # knobs (lists/dicts) from configs written against the reference
-        key = (batch_size, prompt_len, repr(sorted(gen_kwargs.items())), mode)
+        key = (batch_size, prompt_len, repr(sorted(gen_kwargs.items())), mode, bool(capture))
         if key not in self._generate_cache:
             gen_cfg = GenerationConfig.from_gen_kwargs(
                 gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
@@ -279,6 +283,7 @@ class TPUTrainer(BaseRLTrainer):
             fn = make_generate_fn(
                 self.model, self.model_cfg, gen_cfg, mode=mode,
                 logit_mask=self.logit_mask, two_qs=two_qs,
+                capture=capture, capture_split=self.split if capture else 0,
             )
             self._generate_cache[key] = jax.jit(fn)
         return self._generate_cache[key]
@@ -315,12 +320,13 @@ class TPUTrainer(BaseRLTrainer):
         for k, v in out.items():
             if hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] >= b:
                 v = v[:b]
-                if col_pad and k in ("samples", "samples_mask"):
+                if col_pad and k in ("samples", "samples_mask", "h_split"):
                     v = v[:, col_pad:]
             trimmed[k] = v
         return trimmed
 
-    def generate(self, input_ids, attention_mask, gen_kwargs: Optional[Dict] = None, mode: str = "lm"):
+    def generate(self, input_ids, attention_mask, gen_kwargs: Optional[Dict] = None, mode: str = "lm",
+                 capture: bool = False):
         """Sample continuations for a (host) prompt batch; returns the
         sampling dict (device arrays)."""
         gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
@@ -334,7 +340,8 @@ class TPUTrainer(BaseRLTrainer):
                 orig = (orig[0], 0)
         else:
             orig = (input_ids.shape[0], 0)
-        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode)
+        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode,
+                                  capture=capture)
         out = fn(self.params, jnp.asarray(input_ids), jnp.asarray(attention_mask), self.next_rng())
         return self._unbucket_output(out, orig)
 

@@ -706,7 +706,8 @@ class PipelinedCausalMixin:
             seed=self.config.train.seed + self.iter_count + seed_offset,
         )
 
-    def generate(self, input_ids, attention_mask, gen_kwargs=None, mode: str = "lm"):
+    def generate(self, input_ids, attention_mask, gen_kwargs=None, mode: str = "lm",
+                 capture: bool = False):
         gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
         input_ids = np.asarray(input_ids)
         attention_mask = np.asarray(attention_mask)
@@ -716,7 +717,8 @@ class PipelinedCausalMixin:
             )
         else:
             orig = (input_ids.shape[0], 0)
-        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode)
+        fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode,
+                                  capture=capture)
         out = fn(
             self.standard_params(), jnp.asarray(input_ids),
             jnp.asarray(attention_mask), self.next_rng(),

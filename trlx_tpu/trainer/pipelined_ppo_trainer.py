@@ -93,6 +93,24 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
     def _head_module(self):
         return MLPHead(1, self.model_cfg.dtype, self.model_cfg.param_dtype)
 
+    def _fast_rollout_available(self) -> bool:
+        """The rollout fast path is unavailable here: the frozen reference
+        lives STACKED over the pipe axis (_build_ref_params above), and
+        the suffix resume (forward_ref_suffix_window) needs the unstacked
+        per-block layout — the speculative/classic scorer stays in
+        charge."""
+        if (
+            getattr(self.config.method, "capture_rollout_stats", False)
+            and not getattr(self, "_warned_no_fast_rollout", False)
+        ):
+            self._warned_no_fast_rollout = True
+            logger.warning(
+                "method.capture_rollout_stats is ignored under pipeline "
+                "parallelism (stacked reference cannot run the suffix "
+                "resume); using the speculative/classic scorer"
+            )
+        return False
+
     # ------------------------------------------------------------------
     # Loss through the GPipe program
     # ------------------------------------------------------------------

@@ -20,6 +20,7 @@ from trlx_tpu.data import PPORLBatch, PPORLElement
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.data.method_configs import MethodConfig, register_method
 from trlx_tpu.models import (
+    CausalLMWithValueHead,
     build_model,
     forward_policy_and_ref,
     forward_seq2seq_policy_and_ref,
@@ -68,6 +69,13 @@ class PPOConfig(MethodConfig):
     gen_kwargs: dict = field(default_factory=dict)
     gen_experience_kwargs: Optional[dict] = None
     num_value_layers_unfrozen: int = 0
+    # Rollout fast path: the sampling loop itself captures per-token policy
+    # logprobs/values and the hydra-split activations, shrinking the score
+    # phase to the frozen-reference suffix and letting the cycle dispatch
+    # the next rollout ahead of train (cross-cycle reward overlap). Default
+    # off: the classic path stays bit-identical (tests/test_pipelined_cycle
+    # pinning). Extra field vs the reference config set.
+    capture_rollout_stats: bool = False
 
 
 @register_trainer
@@ -644,10 +652,16 @@ class PPOTrainer(TPUTrainer):
     def dispatch_rollout_generation(self):
         """Dispatch generation for the next chunk WITHOUT a host sync.
         Called right after a train dispatch, the device runs it on the
-        just-updated param handles, so rollouts stay on-policy."""
+        just-updated param handles, so rollouts stay on-policy. Under the
+        rollout fast path the sampler additionally captures per-token
+        logprobs/values and the hydra-split activations (and the cycle
+        dispatches it BEFORE train, one step stale — still PPO-correct:
+        the captured logprobs are the behavior policy's, which is exactly
+        what the importance ratio needs)."""
         gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
         batch = next(self.prompt_iterator)
-        out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs)
+        out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs,
+                            capture=self._fast_rollout_available())
         return batch, out
 
     def _build_score_reward_fn(self, scalar_scores: bool):
@@ -671,11 +685,14 @@ class PPOTrainer(TPUTrainer):
             # (accelerate_ppo_trainer.py:470-486): the reference places the
             # scalar score at ends = n_nonpad + 1 (one slot PAST the last
             # real token, landing on a pad position) and masks log_ratio
-            # with attention_mask[:, :-1] (the ENCODER mask, one position
-            # shifted). Both read as off-by-one artifacts of its torch
-            # indexing; here the score lands on the last real response
-            # token (j == n_resp - 1) and the KL mask is the decoder mask
-            # shifted with the labels (decoder_attention_mask[:, 1:]),
+            # with the decoder OUTPUT mask taken over positions [:-1] —
+            # i.e. aligned with the decoder inputs, one slot off the label
+            # positions the logprobs describe (not the encoder mask, which
+            # never enters that expression). Both read as off-by-one
+            # artifacts of its torch indexing; here the score lands on the
+            # last real response token (j == n_resp - 1) and the KL mask is
+            # the decoder mask shifted with the labels
+            # (decoder_attention_mask[:, 1:]),
             # consistent with this repo's _chunk_to_elements and with the
             # causal path below. Curve parity is asserted on the causal
             # path (PARITY_CURVES.json); seq2seq bit-parity with the
@@ -807,6 +824,26 @@ class PPOTrainer(TPUTrainer):
             and getattr(self.tokenizer, "_n_plain_ids", None) is not None
         )
 
+    def _fast_rollout_available(self) -> bool:
+        """The rollout fast path (method.capture_rollout_stats) needs
+        everything the speculative scorer needs — the host retokenize
+        stays the arbiter — PLUS a real hydra split (split > 0: the
+        frozen-reference suffix is what's left to compute after capture),
+        per-step values from the plain v_head (no deep value branch), and
+        single-beam sampling (the while-loop sampler is where capture
+        lives). Overridden to False by the pipelined/sequence-parallel
+        trainers, whose param layouts can't run the unstacked suffix
+        resume."""
+        if not getattr(self.config.method, "capture_rollout_stats", False):
+            return False
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        return (
+            self._spec_path_available()
+            and self.split > 0
+            and getattr(self.config.method, "num_value_layers_unfrozen", 0) == 0
+            and int(gen_kwargs.get("num_beams", 1) or 1) == 1
+        )
+
     def _build_spec_trim_fn(self, q: int, max_new: int):
         """Tiny jit: device-retokenize the raw responses. Kept SEPARATE
         from the speculative forward so the cycle's blocking fetch (which
@@ -909,6 +946,72 @@ class PPOTrainer(TPUTrainer):
         )
         return (trimmed, lp, v, lr, mean_kl)
 
+    def _build_fast_fwd_fn(self, q: int, max_new: int):
+        """Score phase of the rollout fast path: the sampler already
+        captured the policy logprobs, values, and the activations entering
+        the hydra split, so all that's left is the frozen-REFERENCE suffix
+        (blocks [split:] + a response-window unembedding) — no policy or
+        value re-forward at all, ~the suffix fraction of the classic 73 ms
+        score at bench shapes.
+
+        Window semantics match _build_spec_fwd_fn. One documented
+        divergence: mean_kl sums over the response window's real (label)
+        tokens only, while the classic scorer's full-width sum also counts
+        prompt positions (zero there) and the pad label right after an
+        early eos. The difference only feeds the KL controller and
+        logging, and is gated behind method.capture_rollout_stats; the
+        importance ratios used by the loss are identical."""
+        model = self.model
+        split = self.split
+        pad_id = self.tokenizer.pad_token_id
+
+        def fast_fwd(ref_params, samples, h_split, lp_cap, v_cap):
+            attention_mask = (samples != pad_id).astype(jnp.int32)
+            positions = position_ids(attention_mask)
+            start = q - 1
+            ref_logits_w = model.apply(
+                {"params": {"lm": ref_params}}, h_split, attention_mask,
+                positions, split, start, max_new,
+                method=CausalLMWithValueHead.forward_ref_suffix_window,
+            )
+            labels = jax.lax.dynamic_slice_in_dim(samples, q, max_new, axis=1)
+            ref_lp = logprobs_of_labels(ref_logits_w, labels)
+            valid_lab = (labels != pad_id).astype(jnp.float32)
+            log_ratio_w = (lp_cap - ref_lp) * valid_lab
+            kl = jnp.exp(log_ratio_w) - 1 - log_ratio_w
+            # kl is exactly 0 wherever valid_lab is 0, so this window sum
+            # counts real response tokens only
+            return lp_cap, v_cap, log_ratio_w, kl.sum(1).mean()
+
+        return jax.jit(fast_fwd)
+
+    def _dispatch_fast_score(self, out):
+        """Fast-path analogue of _dispatch_spec_score — same (trimmed,
+        lp_win, v_win, logratio_win, mean_kl) contract so the cycle's
+        merge/arbitration machinery is shared. The trim still ships for
+        host arbitration; the forward is just the reference suffix over
+        the CAPTURED activations."""
+        max_new = int(
+            (self.generate_experience_kwargs or self.generate_kwargs)
+            .get("max_new_tokens", 40)
+        )
+        samples = out["samples"]
+        q = samples.shape[1] - out["response_tokens"].shape[1]
+        fns = getattr(self, "_fast_score_fns", None)
+        if fns is None:
+            fns = self._fast_score_fns = {}
+        if (q, max_new) not in fns:
+            fns[(q, max_new)] = (
+                self._build_spec_trim_fn(q, max_new),
+                self._build_fast_fwd_fn(q, max_new),
+            )
+        trim_fn, fwd_fn = fns[(q, max_new)]
+        trimmed = trim_fn(samples)
+        lp, v, lr, mean_kl = fwd_fn(
+            self.ref_params, samples, out["h_split"], out["logprobs"], out["values"]
+        )
+        return (trimmed, lp, v, lr, mean_kl)
+
     def pipelined_cycle(self, pending=None):
         """One full PPO iteration — rollouts, scoring, all inner epochs,
         and the NEXT chunk's generation — with exactly ONE blocking host
@@ -925,6 +1028,19 @@ class PPOTrainer(TPUTrainer):
         reward scoring; the host retokenization arbitrates (exact
         element-for-element match, else classic fallback — counted in
         self.spec_fallbacks).
+
+        Under the rollout fast path (method.capture_rollout_stats +
+        _fast_rollout_available) the schedule restructures further into a
+        one-rollout-ahead double buffer: generation captures the policy
+        logprobs/values in-loop, scoring is just the frozen-ref suffix,
+        and the NEXT cycle's generation is dispatched BEFORE this cycle's
+        train — so on the device stream gen(N+1) runs ahead of train(N),
+        and next cycle's blocking samples fetch + host reward scoring
+        overlap train(N) instead of serializing after it. Generation then
+        runs on one-step-stale params; the captured logprobs are the
+        behavior policy's (exactly what the PPO ratio needs), and the
+        host-side KL-controller update shifts one cycle later to keep the
+        single-fetch discipline.
 
         num_rollouts = k * chunk_size collects k device-resident chunks per
         cycle (all generated on the same params, like make_experience) and
@@ -960,12 +1076,17 @@ class PPOTrainer(TPUTrainer):
             # Availability is re-checked at every dispatch: once a dense
             # reward_fn flips _spec_disabled_dense mid-cycle, no further
             # speculative forwards are wasted.
-            spec_ok = self._spec_path_available()
+            fast_ok = self._fast_rollout_available()
+            spec_ok = fast_ok or self._spec_path_available()
             gens = [self.dispatch_rollout_generation() for _ in range(k)]
-            specs = [
-                self._dispatch_spec_score(o) if spec_ok else None
-                for _, o in gens
-            ]
+            if fast_ok:
+                specs = [self._dispatch_fast_score(o) for _, o in gens]
+            elif spec_ok:
+                specs = [self._dispatch_spec_score(o) for _, o in gens]
+            else:
+                specs = [None] * k
+            # which scorer these handles came from, read back next cycle
+            self._pending_fast = fast_ok
             return gens, specs
 
         if pending is None:
@@ -974,19 +1095,41 @@ class PPOTrainer(TPUTrainer):
         gens, specs, prev = pending
         # what was actually dispatched last cycle, not current availability
         use_spec = specs[0] is not None
+        use_fast = use_spec and bool(getattr(self, "_pending_fast", False))
 
-        # The cycle's single blocking fetch: every chunk's raw samples
-        # (+ the speculative trims for arbitration) + the previous cycle's
-        # loss/KL handles, bundled into one device_get.
+        # The cycle's blocking fetch: every chunk's raw samples (+ the
+        # speculative trims for arbitration) + the previous cycle's
+        # loss/KL handles, bundled into one device_get. Fast schedule:
+        # the previous TRAIN was dispatched after these generations, so
+        # waiting on its handles here would forfeit the overlap — fetch
+        # samples/trims only, do all host reward work, and collect the
+        # train handles in a second (by then already-resolved) fetch.
         fetch = [o["samples"] for _, o in gens]
         if use_spec:
             fetch.extend(s[0] for s in specs)
-        if prev is not None:
+        if prev is not None and not use_fast:
             fetch.extend(prev)
         fetched = jax.device_get(tuple(fetch))
         samples_list = fetched[:k]
         trimmed_list = fetched[k:2 * k] if use_spec else [None] * k
-        if prev is not None:
+
+        processed = None
+        if use_fast:
+            # host decode + reward scoring for every chunk, overlapping
+            # the previous cycle's still-running train
+            processed = []
+            for (batch, _), samples in zip(gens, samples_list):
+                stats: Dict[str, float] = {}
+                processed.append(self._host_process_chunk(batch, samples, stats))
+            if prev is not None:
+                prev_vals = jax.device_get(tuple(prev))
+                prev_loss = float(prev_vals[0])
+                self.mean_kl = float(prev_vals[1])
+                for _ in range(method.ppo_epochs):
+                    self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)
+            else:
+                prev_loss = None
+        elif prev is not None:
             prev_loss = float(fetched[-2])
             self.mean_kl = float(fetched[-1])
             # classic cadence: post_backward_callback fires once per inner
@@ -998,13 +1141,16 @@ class PPOTrainer(TPUTrainer):
             prev_loss = None
 
         chunks, kl_handles = [], []
-        for (batch, out), spec, samples, spec_trimmed in zip(
+        for ci, ((batch, out), spec, samples, spec_trimmed) in enumerate(zip(
             gens, specs, samples_list, trimmed_list
-        ):
-            stats: Dict[str, float] = {}
-            prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
-                self._host_process_chunk(batch, samples, stats)
-            )
+        )):
+            if processed is not None:
+                prompt_tensors, sample_outputs, outputs, scores, scores_mask = processed[ci]
+            else:
+                stats = {}
+                prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
+                    self._host_process_chunk(batch, samples, stats)
+                )
 
             scalar = scores.shape[1] == 1
             if scalar:
@@ -1027,6 +1173,13 @@ class PPOTrainer(TPUTrainer):
                 and np.array_equal(
                     np.asarray(batch["input_ids"]),
                     samples[:, :prompt_tensors.shape[1]],
+                )
+                # fast path: captured stats index the RAW response tokens
+                # — require raw == host-retokenized so the windows align
+                # 1:1 (else classic fallback rescoring, like a trim miss)
+                and (
+                    not use_fast
+                    or np.array_equal(samples[:, prompt_tensors.shape[1]:], sample_outputs)
                 )
             )
             if spec_hit:
@@ -1068,9 +1221,19 @@ class PPOTrainer(TPUTrainer):
             # cycle KL = mean over chunks (classic make_experience averages
             # its per-chunk stats the same way)
             mean_kl = jnp.mean(jnp.stack(kl_handles))
-        stats = self.train_epochs_from_chunk(full, method.ppo_epochs)
 
-        nxt_gens, nxt_specs = dispatch_chunks()
+        if self._fast_rollout_available():
+            # double-buffer one rollout ahead: gen(N+1) enqueues BEFORE
+            # train(N), so next cycle's samples fetch and host reward
+            # scoring hide under train(N). One step stale is PPO-sound —
+            # the captured logprobs ARE the behavior policy's — and
+            # donation-safe: train's donated buffers only invalidate
+            # consumers enqueued after it, and the gens are already in.
+            nxt_gens, nxt_specs = dispatch_chunks()
+            stats = self.train_epochs_from_chunk(full, method.ppo_epochs)
+        else:
+            stats = self.train_epochs_from_chunk(full, method.ppo_epochs)
+            nxt_gens, nxt_specs = dispatch_chunks()
         handles = (stats["losses"]["total_loss"], mean_kl)
         return prev_loss, (nxt_gens, nxt_specs, handles)
 

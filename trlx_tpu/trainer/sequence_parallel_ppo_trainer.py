@@ -77,6 +77,23 @@ class SequenceParallelPPOTrainer(PPOTrainer):
     def create_train_dataloader(self, seed_offset: int = 0, drop_last: bool = True):
         return super().create_train_dataloader(seed_offset, drop_last=True)
 
+    def _fast_rollout_available(self) -> bool:
+        """The rollout fast path is unavailable here: scoring runs inside
+        a shard_map over the sequence axis (_build_score_fn below), and
+        the captured h_split/suffix resume lives outside that layout —
+        the speculative/classic scorer stays in charge."""
+        if (
+            getattr(self.config.method, "capture_rollout_stats", False)
+            and not getattr(self, "_warned_no_fast_rollout", False)
+        ):
+            self._warned_no_fast_rollout = True
+            logger.warning(
+                "method.capture_rollout_stats is ignored under sequence "
+                "parallelism (sharded scoring cannot consume the captured "
+                "split activations); using the speculative/classic scorer"
+            )
+        return False
+
     # ------------------------------------------------------------------
     # Shared shard_map forward: per-position logprobs (+values, +ref)
     # ------------------------------------------------------------------

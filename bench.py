@@ -129,7 +129,23 @@ def fast_rollout_requested(argv) -> bool:
     )
 
 
-def build_trainer(smoke: bool = False, fast: bool = False):
+def trunk_cache_requested(argv) -> bool:
+    """The frozen-trunk activation cache (h_split captured once per
+    rollout chunk, every train epoch runs the suffix only) is ON by
+    default in the bench harness — the library default stays off, but the
+    headline measurement exercises the cached train schedule, and the
+    flag-off number is still reported every run via the same-process
+    `train_full` phase. Opt out with `--no-trunk-cache` (or
+    `method.cache_trunk_activations=false`)."""
+    return not any(
+        a.replace(" ", "") in ("method.cache_trunk_activations=false",
+                               "--no-trunk-cache")
+        for a in argv
+    )
+
+
+def build_trainer(smoke: bool = False, fast: bool = False,
+                  trunk_cache: bool = False):
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
     from trlx_tpu.trainer.ppo_trainer import PPOTrainer
@@ -137,6 +153,8 @@ def build_trainer(smoke: bool = False, fast: bool = False):
     config = default_ppo_config()
     if fast:
         config = config.evolve(method=dict(capture_rollout_stats=True))
+    if trunk_cache:
+        config = config.evolve(method=dict(cache_trunk_activations=True))
     if smoke:
         # num_layers_unfrozen 1 (not the default 2): gpt2-tiny has two
         # blocks, and a 2-of-2 split leaves no frozen suffix — which
@@ -226,7 +244,8 @@ def run_cycle(trainer, config):
 
 def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
                     unfrozen, window_ok: bool = True,
-                    fast_path: bool = False) -> dict:
+                    fast_path: bool = False,
+                    trunk_cache: bool = False) -> dict:
     """Itemized FLOP estimate for one PPO cycle (documented approximations;
     used only for the MFU estimate, never for vs_baseline).
 
@@ -264,6 +283,11 @@ def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
         # scoring: full policy+value fwd, plus the in-graph frozen-reference
         # branch re-running the top `unfrozen` blocks + lm_head
         score = fwd(T, T / 2) + fwd(T, T / 2, layers=unfrozen)
+    if trunk_cache and not fast_path:
+        # trunk cache on the classic schedule: ONE extra frozen-prefix pass
+        # per chunk fills the cache (on the fast schedule the sampler's
+        # in-loop capture makes it free — already counted under gen)
+        score = score + fwd(T, T / 2, layers=L - unfrozen, with_head=False)
     # one train step: the trunk runs full-width fwd + dX/dW over the
     # unfrozen top. When the r5 windowed head applies (ppo_trainer
     # forward_window — no MoE, no deeper value branch, no soft prompt),
@@ -271,7 +295,15 @@ def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
     # positions the loss reads; otherwise the step really computes the
     # full-width head and the estimate must charge all T positions.
     head_tokens = n_new if window_ok else T
-    train = (fwd(T, T / 2, with_head=False) + head_tokens * head
+    if trunk_cache:
+        # cached schedule (r6): the frozen prefix comes from the per-chunk
+        # cache, so each inner epoch's forward is suffix-only — the top
+        # `unfrozen` blocks + head — while backward is unchanged (grads
+        # already stop at the first trainable layer)
+        train_fwd = fwd(T, T / 2, layers=unfrozen, with_head=False)
+    else:
+        train_fwd = fwd(T, T / 2, with_head=False)
+    train = (train_fwd + head_tokens * head
              + fwd(T, T / 2, layers=unfrozen, with_head=False) + head_tokens * head
              + fwd(T, T / 2, layers=unfrozen, with_head=False))
     per_sample = gen + score + ppo_epochs * train
@@ -417,11 +449,38 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
         times["score"] = max(t - rtt, 1e-9)
         chunk = chunk[0]
     np.asarray(chunk.rewards[0, 0])
+
+    extra = {"train_schedule": "full"}
+    trunk_cache = trainer._trunk_cache_available()
+    if trunk_cache:
+        # attach the frozen-trunk cache exactly like the cycle does (reuse
+        # of the sampler's capture on the fast schedule, else one jitted
+        # trunk pass) and time it as its own phase
+        t, chunk = timed(
+            lambda: trainer._attach_trunk_cache(
+                chunk, captured=out.get("trunk_cache")
+            ),
+            lambda c: c.h_split[0, 0, 0],
+        )
+        times["cache_trunk"] = max(t - rtt, 1e-9)
+        extra["train_schedule"] = "trunk_cache"
+        extra["trunk_cache_hbm_bytes"] = int(
+            chunk.h_split.size * chunk.h_split.dtype.itemsize
+        )
     t, _ = timed(
         lambda: trainer.train_epochs_from_chunk(chunk, method.ppo_epochs),
         lambda st: st["losses"]["total_loss"],
     )
     times["train"] = max(t - rtt, 1e-9)
+    if trunk_cache:
+        # same-process A/B for the acceptance gate: the identical chunk
+        # trained WITHOUT the cache (full forward every epoch)
+        full_chunk = chunk.replace(h_split=None)
+        t, _ = timed(
+            lambda: trainer.train_epochs_from_chunk(full_chunk, method.ppo_epochs),
+            lambda st: st["losses"]["total_loss"],
+        )
+        times["train_full"] = max(t - rtt, 1e-9)
 
     phase_mfu = {
         k: round(flops[k] / times[k] / n_chips / peak, 4)
@@ -429,7 +488,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     }
     schedule = ("fast_overlap" if fast
                 else "spec_overlap" if spec is not None else "classic")
-    return times, phase_mfu, rtt, schedule
+    return times, phase_mfu, rtt, schedule, extra
 
 
 def main():
@@ -551,7 +610,8 @@ def main():
 
     classic = "--classic" in sys.argv
     fast = fast_rollout_requested(sys.argv[1:])
-    trainer, config = build_trainer(smoke, fast=fast)
+    trunk_cache = trunk_cache_requested(sys.argv[1:])
+    trainer, config = build_trainer(smoke, fast=fast, trunk_cache=trunk_cache)
     n_chips = max(jax.device_count(), 1)
 
     # >=100 cycles / >=45s: r3's 21-cycle/10.6s window was small enough
@@ -601,6 +661,7 @@ def main():
         config.method.ppo_epochs, config.model.num_layers_unfrozen,
         window_ok=window_ok,
         fast_path=(not classic) and trainer._fast_rollout_available(),
+        trunk_cache=trainer._trunk_cache_available(),
     )
     mfu = flops["total"] * cycles / elapsed / n_chips / chip_peak_flops()
 
@@ -608,7 +669,7 @@ def main():
     phase_json = {}
     if not classic:
         try:
-            times, phase_mfu, rtt, schedule = measure_phases(
+            times, phase_mfu, rtt, schedule, extra = measure_phases(
                 trainer, config, flops, n_chips
             )
             cycle_wall = elapsed / cycles
@@ -619,6 +680,7 @@ def main():
                 "relay_rtt_seconds": round(rtt, 4),
                 "overlap_efficiency": round(device_busy / cycle_wall, 3),
                 "schedule": schedule,
+                **extra,
             }
             sys.stderr.write(
                 f"[bench] phase device-times ({schedule} schedule, "
@@ -626,12 +688,21 @@ def main():
                 + " | ".join(
                     f"{k} {times[k]*1e3:.0f}ms"
                     + (f" (MFU {phase_mfu[k]:.3f})" if k in phase_mfu else "")
-                    for k in ("generate", "score", "host_fetch_process", "train")
+                    for k in ("generate", "score", "host_fetch_process",
+                              "cache_trunk", "train", "train_full")
                     if k in times
                 )
                 + f" | rtt {rtt*1e3:.0f}ms | cycle wall {cycle_wall*1e3:.0f}ms"
                 f" | overlap {phase_json['overlap_efficiency']:.2f}\n"
             )
+            if "train_full" in times:
+                sys.stderr.write(
+                    f"[bench] trunk-cache train A/B (same process, same "
+                    f"chunk): cached {times['train']*1e3:.0f}ms vs full "
+                    f"{times['train_full']*1e3:.0f}ms "
+                    f"({(1 - times['train'] / times['train_full']) * 100:.0f}% "
+                    f"device-time reduction)\n"
+                )
         except Exception as e:  # the headline must survive instrumentation
             sys.stderr.write(f"[bench] phase instrumentation failed: {e}\n")
 

@@ -61,6 +61,10 @@ class PPORLElement:
     logprobs: np.ndarray  # [response_size]
     values: np.ndarray  # [response_size]
     rewards: np.ndarray  # [response_size]
+    # frozen-trunk activation entering the hydra split, full sample width
+    # [query_size + response_size(+1), d_model]; only populated when
+    # method.cache_trunk_activations is on (None otherwise)
+    h_split: Optional[np.ndarray] = None
 
 
 @flax.struct.dataclass
@@ -73,6 +77,11 @@ class PPORLBatch:
     logprobs: Any  # f32 [b, padded_response]
     values: Any  # f32 [b, padded_response]
     rewards: Any  # f32 [b, padded_response]
+    # optional frozen-trunk activation cache aligned with
+    # concat(query_tensors, response_tensors): [b, padded_q + padded_r, d]
+    # in method.trunk_cache_dtype; None (no pytree leaf) when the trunk
+    # cache is off, so every existing 5-field constructor/scan still works
+    h_split: Any = None
 
 
 # ---------------------------------------------------------------------------

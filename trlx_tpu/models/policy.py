@@ -124,7 +124,55 @@ class CausalLMWithValueHead(nn.Module):
         fast path, where the sampler already captured h_split and only the
         response window of the reference logits is needed."""
         return self.lm.forward_from_window(h_split, attn_mask, positions, start_layer,
-                                           start, length)
+                                           start, length)[0]
+
+    def forward_trunk(self, tokens, attn_mask, positions=None, split: int = 0):
+        """Frozen-prefix pass: embeddings + blocks [0, split) only — the
+        activation entering the hydra split, with no heads. One jitted call
+        per rollout chunk fills the PPO trunk cache
+        (method.cache_trunk_activations) when the capture sampler didn't
+        already produce it."""
+        return self.lm.forward_trunk(tokens, attn_mask, positions, split)
+
+    def forward_from_cache(self, h_split, attn_mask, positions=None,
+                           start_layer: int = 0):
+        """(logits, values) resuming the TRAINABLE suffix from a cached
+        trunk activation — the trunk-cache train path's replacement for
+        __call__. Apply with the live (policy) params: blocks
+        [start_layer, n_layers) + unembed + value head all run, only the
+        frozen-prefix forward is skipped. Exact when the trunk is entirely
+        frozen (split > 0 implies it is). Supports the deeper value branch
+        as long as its tap point is at/above start_layer (the gate
+        guarantees this)."""
+        if self.num_value_layers > 0:
+            value_split = self.cfg.n_layers - self.num_value_layers
+            logits, _, h_value = self.lm.forward_from_captures(
+                h_split, attn_mask, positions, start_layer, value_split
+            )
+            if positions is None:
+                positions = self.lm._default_positions(h_split, attn_mask)
+            values = self.value_branch(h_value, attn_mask, positions)
+            return logits, values
+        logits, h_final, _ = self.lm.forward_from_captures(
+            h_split, attn_mask, positions, start_layer
+        )
+        return logits, self.v_head(h_final)[..., 0]
+
+    def forward_from_cache_window(self, h_split, attn_mask, positions=None,
+                                  start_layer: int = 0, start: int = 0,
+                                  length: int = 1):
+        """`forward_from_cache` composed with the windowed unembedding:
+        (logits_win, values_win) over [start, start+length) only. Same
+        value-branch restriction as forward_window."""
+        if self.num_value_layers > 0:
+            raise NotImplementedError(
+                "forward_from_cache_window with a value branch is "
+                "unsupported (branch blocks attend over the full sequence)"
+            )
+        logits, h_final = self.lm.forward_from_window(
+            h_split, attn_mask, positions, start_layer, start, length
+        )
+        return logits, self.v_head(h_final)[..., 0]
 
     def forward_ref_full(self, tokens, attn_mask, positions=None):
         """Full reference forward (used when every layer is trainable).

@@ -731,6 +731,57 @@ class TransformerLM(nn.Module):
         logits, _ = self.unembed(h)
         return logits
 
+    def forward_trunk(
+        self,
+        tokens: jnp.ndarray,
+        attn_mask: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        split: int = 0,
+    ) -> jnp.ndarray:
+        """Embeddings + blocks [0, split) ONLY — the frozen-prefix pass
+        producing the activation entering block `split` (the same h_split
+        `__call__` captures), with no unembedding. One such pass per rollout
+        chunk feeds the PPO trunk cache (method.cache_trunk_activations)
+        when the sampler didn't already capture it in-loop."""
+        if self.cfg.prompt_tokens > 0:
+            raise NotImplementedError(
+                "forward_trunk under prompt tuning is unsupported (the soft "
+                "prompt widens the captured rows; resolve_split gates it off)"
+            )
+        if positions is None:
+            positions = self._default_positions(tokens, attn_mask)
+        h = self.embed(tokens, positions)
+        bias = self._train_bias(attn_mask)
+        h, _ = self.run_blocks(h, bias, positions, 0, split, attn_mask=attn_mask)
+        return h
+
+    def forward_from_captures(
+        self,
+        h: jnp.ndarray,
+        attn_mask: jnp.ndarray,
+        positions: Optional[jnp.ndarray] = None,
+        start_layer: int = 0,
+        value_split: Optional[int] = None,
+    ):
+        """`forward_from` keeping the hidden states a value head needs:
+        resume blocks [start_layer, n_layers) from a cached/captured hidden
+        state, full-width unembed. Returns (logits, h_final, h_value) where
+        h_value is the activation entering block `value_split` (the deeper
+        value branch's input; requires start_layer <= value_split). With
+        value_split=None, h_value is the input `h` (unused by callers)."""
+        if positions is None:
+            positions = self._default_positions(h, attn_mask)
+        bias = self._train_bias(attn_mask)
+        vs = start_layer if value_split is None else value_split
+        caps = {}
+        bounds = sorted({start_layer, vs, self.cfg.n_layers})
+        for s, e in zip(bounds, bounds[1:]):
+            caps[s] = h
+            h, _ = self.run_blocks(h, bias, positions, s, e, attn_mask=attn_mask)
+        caps[self.cfg.n_layers] = h
+        logits, h_final = self.unembed(h)
+        return logits, h_final, caps[vs]
+
     def forward_from_window(
         self,
         h: jnp.ndarray,
@@ -739,21 +790,22 @@ class TransformerLM(nn.Module):
         start_layer: int = 0,
         start: int = 0,
         length: int = 1,
-    ) -> jnp.ndarray:
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """`forward_from` with the windowed unembedding of
         `forward_window`: run blocks [start_layer, n_layers) over the full
         width, then final norm + head over positions [start, start+length)
-        only. The rollout fast path reads just the response window of the
-        frozen-reference logits, and the 2·d·V head matmul dominates the
-        suffix at bench shapes."""
+        only. Returns (logits_win, h_final_win) like forward_window — the
+        rollout fast path reads just the response window of the
+        frozen-reference logits, the trunk-cache train path additionally
+        feeds h_final_win to the value head, and the 2·d·V head matmul
+        dominates the suffix at bench shapes."""
         if positions is None:
             positions = self._default_positions(h, attn_mask)
         bias = self._train_bias(attn_mask)
         h, _ = self.run_blocks(h, bias, positions, start_layer, self.cfg.n_layers,
                                attn_mask=attn_mask)
         hw = jax.lax.dynamic_slice_in_dim(h, start, length, axis=1)
-        logits, _ = self.unembed(hw)
-        return logits
+        return self.unembed(hw)
 
     def decode_step(
         self,

@@ -38,7 +38,8 @@ class PPORolloutStorage(BaseRolloutStore):
         fpath = os.path.join(location, f"epoch-{str(time.time())}.json")
 
         def exp_to_dict(exp):
-            return {k: np.asarray(v).tolist() for k, v in exp.__dict__.items()}
+            return {k: np.asarray(v).tolist() for k, v in exp.__dict__.items()
+                    if v is not None}
 
         data = [exp_to_dict(exp) for exp in self.history]
         if only_text:
@@ -86,12 +87,31 @@ class PPORolloutStorage(BaseRolloutStore):
             queries, responses, logprobs, values, rewards = ppo_collate(
                 elems, max_q, max_r, max_p, pad_id, left_queries
             )
+            h_split = None
+            if all(e.h_split is not None for e in elems):
+                # Trunk-cache collation: align each element's rows with the
+                # padded concat(query, response) layout. Zero-filled pad
+                # rows are EXACT — padded columns are attention-masked and
+                # exp(-1e9) underflows to 0.0, so their values are never
+                # read by the resumed suffix.
+                d = elems[0].h_split.shape[-1]
+                dt = elems[0].h_split.dtype
+                h_split = np.zeros((len(elems), max_q + max_r, d), dtype=dt)
+                for i, e in enumerate(elems):
+                    qi = len(e.query_tensor)
+                    w = min(e.h_split.shape[0] - qi, max_r)
+                    if left_queries:
+                        h_split[i, max_q - qi:max_q] = e.h_split[:qi]
+                    else:
+                        h_split[i, :qi] = e.h_split[:qi]
+                    h_split[i, max_q:max_q + w] = e.h_split[qi:qi + w]
             return PPORLBatch(
                 query_tensors=queries,
                 response_tensors=responses,
                 logprobs=logprobs,
                 values=values,
                 rewards=rewards,
+                h_split=h_split,
             )
 
         return DataLoader(

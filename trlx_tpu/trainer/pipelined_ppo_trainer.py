@@ -111,6 +111,23 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             )
         return False
 
+    def _trunk_cache_available(self) -> bool:
+        """The trunk cache is unavailable here for the same reason as the
+        fast rollout path: params live STACKED over the pipe axis, and
+        the suffix resume (forward_from_cache) needs the unstacked
+        per-block layout — the full-forward train loss stays in charge."""
+        if (
+            getattr(self.config.method, "cache_trunk_activations", False)
+            and not getattr(self, "_warned_no_trunk_cache", False)
+        ):
+            self._warned_no_trunk_cache = True
+            logger.warning(
+                "method.cache_trunk_activations is ignored under pipeline "
+                "parallelism (stacked params cannot run the suffix resume); "
+                "training with the full forward"
+            )
+        return False
+
     # ------------------------------------------------------------------
     # Loss through the GPipe program
     # ------------------------------------------------------------------

@@ -76,6 +76,21 @@ class PPOConfig(MethodConfig):
     # off: the classic path stays bit-identical (tests/test_pipelined_cycle
     # pinning). Extra field vs the reference config set.
     capture_rollout_stats: bool = False
+    # Frozen-trunk activation cache: the hydra trunk (embeddings + blocks
+    # below the split) is entirely frozen, so its output for a rollout
+    # chunk's tokens is invariant across all ppo_epochs inner epochs.
+    # Capture h_split once per chunk (reusing the rollout fast path's
+    # in-loop capture when available, else one jitted trunk pass) and
+    # train the suffix from it (forward_from_cache[_window]), skipping the
+    # frozen-prefix forward every optimizer step. Default off: flag off is
+    # bit-identical to the uncached loss. Extra fields vs the reference.
+    cache_trunk_activations: bool = False
+    trunk_cache_dtype: str = "bfloat16"
+    # Whiten advantages over real response tokens only (GAE whitening
+    # currently normalizes across padded positions too, biasing mean/std
+    # for short responses). Default off to preserve reference-parity
+    # curves (the reference whitens unmasked, utils/modeling.py whiten).
+    whiten_with_mask: bool = False
 
 
 @register_trainer
@@ -110,6 +125,8 @@ class PPOTrainer(TPUTrainer):
             self.setup_rollout_logging(config)
 
         self._score_fn = None
+        self._trunk_cache_fn = None
+        self._cache_cast_fn = None
 
     def _build_ref_params(self):
         """Extract + place the frozen reference subtree (overridden by the
@@ -170,13 +187,15 @@ class PPOTrainer(TPUTrainer):
                 old_rewards = batch.rewards
                 response_length = old_rewards.shape[1]
 
-                advantages, returns = get_advantages_and_returns(
-                    old_values, old_rewards, method.gamma, method.lam
-                )
-
                 attention_mask = (query_tensors != pad_id).astype(jnp.int32)
                 decoder_attention_mask = (response_tensors != pad_id).astype(jnp.int32)
                 decoder_attention_mask = decoder_attention_mask.at[:, 0].set(1)
+                gae_mask = decoder_attention_mask[:, 1:][:, :response_length]
+
+                advantages, returns = get_advantages_and_returns(
+                    old_values, old_rewards, method.gamma, method.lam,
+                    mask=gae_mask if method.whiten_with_mask else None,
+                )
 
                 logits, values_pred, _, _ = model.apply(
                     {"params": params},
@@ -215,22 +234,57 @@ class PPOTrainer(TPUTrainer):
             old_rewards = batch.rewards
             response_length = old_rewards.shape[1]
 
-            advantages, returns = get_advantages_and_returns(
-                old_values, old_rewards, method.gamma, method.lam
-            )
-
             tokens = jnp.concatenate([query_tensors, response_tensors], axis=1)
             attention_mask = (tokens != pad_id).astype(jnp.int32)
             positions = position_ids(attention_mask)
             start = query_tensors.shape[1] - 1
             end = start + response_length
+            mask = attention_mask[:, start + 1 : end + 1]
+
+            advantages, returns = get_advantages_and_returns(
+                old_values, old_rewards, method.gamma, method.lam,
+                mask=mask if method.whiten_with_mask else None,
+            )
 
             def window_from_full(logits, values_full):
                 lp = logprobs_of_labels(logits[:, :-1, :], tokens[:, 1:])
                 return lp[:, start:end], values_full[:, :-1][:, start:end]
 
             moe_aux = 0.0
-            if getattr(self.model_cfg, "moe_experts", 0) > 0:
+            if batch.h_split is not None:
+                # Trunk-cache train path (method.cache_trunk_activations):
+                # resume the trainable suffix from the per-chunk cached
+                # activation entering block `split`. Exact: the trunk is
+                # entirely frozen (split > 0 implies it), padded columns
+                # are attention-masked (exp(-1e9) == 0.0 in f32, so
+                # zero-filled cache rows contribute exactly nothing), and
+                # gradients already stopped at the first trainable layer —
+                # backward is unchanged.
+                h0 = batch.h_split
+                cache_sharding = self._trunk_cache_sharding()
+                if cache_sharding is not None and isinstance(h0, jax.core.Tracer):
+                    # inside jit this is a pure layout hint; in eager mode it
+                    # would be a reshard (device_put) that perturbs backward
+                    # reduction order and breaks the bitwise-equality contract
+                    h0 = jax.lax.with_sharding_constraint(h0, cache_sharding)
+                h0 = jax.lax.stop_gradient(h0.astype(self.model_cfg.dtype))
+                if self._window_loss_ok():
+                    logits_w, values_pred = model.apply(
+                        {"params": params}, h0, attention_mask, positions,
+                        self.split, start, response_length,
+                        method=type(model).forward_from_cache_window,
+                    )
+                    logprobs = logprobs_of_labels(
+                        logits_w, tokens[:, start + 1:end + 1]
+                    )
+                else:
+                    logits, values_full = model.apply(
+                        {"params": params}, h0, attention_mask, positions,
+                        self.split,
+                        method=type(model).forward_from_cache,
+                    )
+                    logprobs, values_pred = window_from_full(logits, values_full)
+            elif getattr(self.model_cfg, "moe_experts", 0) > 0:
                 from trlx_tpu.utils.modeling import apply_with_moe_aux
 
                 (logits, values_full, _), moe_aux = apply_with_moe_aux(
@@ -258,7 +312,6 @@ class PPOTrainer(TPUTrainer):
                     {"params": params}, tokens, attention_mask, positions
                 )
                 logprobs, values_pred = window_from_full(logits, values_full)
-            mask = attention_mask[:, start + 1 : end + 1]
 
             loss, stats = ppo_loss(
                 logprobs=logprobs,
@@ -404,18 +457,31 @@ class PPOTrainer(TPUTrainer):
                     self.train_params, self.frozen_params, self.ref_params,
                     jnp.asarray(all_tokens),
                 )
+            h_cache = None
+            if self._trunk_cache_available():
+                # one frozen-prefix pass per chunk over the SAME retokenized
+                # tokens the scorer saw; amortized over ppo_epochs inner
+                # epochs of suffix-only training. Dispatched before the
+                # blocking fetch so it overlaps the stats transfer.
+                if self._trunk_cache_fn is None:
+                    self._trunk_cache_fn = self._build_trunk_cache_fn()
+                h_cache = self._trunk_cache_fn(
+                    self.train_params, self.frozen_params, jnp.asarray(all_tokens)
+                )
             # ONE batched device->host fetch: sequential np.asarray calls
             # each pay a full relay round trip (~100ms on tunneled TPU
             # backends), jax.device_get pipelines them together.
-            logprobs, values, log_ratio, mean_kl, mean_kl_per_token = jax.device_get(
-                (logprobs, values, log_ratio, mean_kl, mean_kl_per_token)
+            logprobs, values, log_ratio, mean_kl, mean_kl_per_token, h_cache = (
+                jax.device_get(
+                    (logprobs, values, log_ratio, mean_kl, mean_kl_per_token, h_cache)
+                )
             )
             mean_kl = float(mean_kl)
             mean_kl_per_token = float(mean_kl_per_token)
 
             ppo_rl_elements.extend(self._chunk_to_elements(
                 prompt_tensors, sample_outputs, outputs, scores, scores_mask,
-                logprobs, values, log_ratio,
+                logprobs, values, log_ratio, h_cache,
             ))
 
             stats["time/rollout_time"] = clock.tick()
@@ -566,7 +632,8 @@ class PPOTrainer(TPUTrainer):
         return prompt_tensors, sample_outputs, outputs, scores, scores_mask
 
     def _chunk_to_elements(self, prompt_tensors, sample_outputs, outputs,
-                           scores, scores_mask, logprobs, values, log_ratio):
+                           scores, scores_mask, logprobs, values, log_ratio,
+                           h_cache=None):
         """Slice per-sample response windows into PPORLElements (host
         numpy). logprob[i] is the (log)prob with which all_tokens[i+1] was
         sampled; for seq2seq everything is decoder-relative, so the window
@@ -605,6 +672,13 @@ class PPOTrainer(TPUTrainer):
                     logprobs=logprobs[ix, start:end],
                     values=values[ix, start:end],
                     rewards=rewards,
+                    # trunk cache rows for exactly this element's
+                    # query + response tokens (the loader's collation
+                    # re-pads them into the batch layout)
+                    h_split=(
+                        None if h_cache is None
+                        else h_cache[ix, : prompt_tensors.shape[1] + n_resp]
+                    ),
                 )
             )
         return elements
@@ -844,6 +918,102 @@ class PPOTrainer(TPUTrainer):
             and int(gen_kwargs.get("num_beams", 1) or 1) == 1
         )
 
+    # ------------------------------------------------------------------
+    # Frozen-trunk activation cache (method.cache_trunk_activations)
+    # ------------------------------------------------------------------
+
+    def _trunk_cache_available(self) -> bool:
+        """Whether the train phase may run from cached trunk activations.
+        Mirrors _fast_rollout_available's preconditions on the model
+        geometry (but not on the sampler — the cache works on the classic
+        schedule too, via one extra jitted trunk pass per chunk): a real
+        hydra split (split > 0 means blocks [0, split) are entirely
+        frozen, so the cache can never go stale within a collection), a
+        causal LM (seq2seq's encoder/decoder split has no single trunk
+        activation), no MoE (expert routing recomputes the aux loss from
+        the full forward), and a value branch tapping at/above the split
+        (its input must be derivable from h_split). Overridden to False
+        by the pipelined/sequence-parallel trainers, whose param layouts
+        can't run the unstacked suffix resume."""
+        if not getattr(self.config.method, "cache_trunk_activations", False):
+            return False
+        n_value = getattr(self.config.method, "num_value_layers_unfrozen", 0)
+        return (
+            not self.seq2seq
+            and self.split > 0
+            and getattr(self.model_cfg, "moe_experts", 0) == 0
+            and self.model_cfg.n_layers - n_value >= self.split
+        )
+
+    def _trunk_cache_sharding(self):
+        """NamedSharding for a [b, T, d] activation cache: batch over the
+        DP axes, sequence over the sequence axis, features replicated — an
+        EXPLICIT constraint so param donation in the train step never
+        relayouts the cache between epochs. None when the mesh doesn't
+        carry the standard axes (the pipe mesh; those trainers gate the
+        cache off anyway)."""
+        axes = self.runtime.mesh.axis_names
+        if "data" not in axes:
+            return None
+        batch_axes = ("data", "fsdp") if "fsdp" in axes else ("data",)
+        seq_axis = "sequence" if "sequence" in axes else None
+        return self.runtime.sharding(batch_axes, seq_axis, None)
+
+    def _build_trunk_cache_fn(self):
+        """Jitted frozen-prefix pass: concat(query, response) tokens ->
+        h_split in method.trunk_cache_dtype, placed per
+        _trunk_cache_sharding. One call per rollout chunk — amortized over
+        ppo_epochs inner epochs of suffix-only training."""
+        model = self.model
+        split = self.split
+        pad_id = self.tokenizer.pad_token_id
+        dtype = getattr(self.config.method, "trunk_cache_dtype", "bfloat16")
+
+        def trunk(train_params, frozen_params, tokens):
+            params = merge_params(train_params, frozen_params)
+            attention_mask = (tokens != pad_id).astype(jnp.int32)
+            positions = position_ids(attention_mask)
+            h = model.apply(
+                {"params": params}, tokens, attention_mask, positions, split,
+                method=CausalLMWithValueHead.forward_trunk,
+            )
+            return h.astype(dtype)
+
+        return jax.jit(trunk, out_shardings=self._trunk_cache_sharding())
+
+    def _build_cache_cast_fn(self):
+        """Jitted cast + placement for an ALREADY-captured h_split (the
+        rollout fast path's in-loop capture) — no forward at all."""
+        dtype = getattr(self.config.method, "trunk_cache_dtype", "bfloat16")
+        return jax.jit(
+            lambda h: h.astype(dtype),
+            out_shardings=self._trunk_cache_sharding(),
+        )
+
+    def _attach_trunk_cache(self, chunk: PPORLBatch, captured=None) -> PPORLBatch:
+        """Attach the frozen-trunk activation cache to a device-resident
+        chunk. `captured` is the sampler's in-loop h_split (rollout fast
+        path, satellite of the same schedule) — reused when its width
+        matches the chunk's concat(query, response) layout (a fast-path
+        spec hit guarantees raw == retokenized, so it does); otherwise one
+        jitted trunk pass recomputes it. Called for EVERY chunk when the
+        gate is on, so k>1 concatenation sees a uniform pytree structure."""
+        if not self._trunk_cache_available():
+            return chunk
+        width = chunk.query_tensors.shape[1] + chunk.response_tensors.shape[1]
+        if captured is not None and captured.shape[1] == width:
+            if self._cache_cast_fn is None:
+                self._cache_cast_fn = self._build_cache_cast_fn()
+            return chunk.replace(h_split=self._cache_cast_fn(captured))
+        if self._trunk_cache_fn is None:
+            self._trunk_cache_fn = self._build_trunk_cache_fn()
+        tokens = jnp.concatenate(
+            [jnp.asarray(chunk.query_tensors), jnp.asarray(chunk.response_tensors)],
+            axis=1,
+        )
+        h = self._trunk_cache_fn(self.train_params, self.frozen_params, tokens)
+        return chunk.replace(h_split=h)
+
     def _build_spec_trim_fn(self, q: int, max_new: int):
         """Tiny jit: device-retokenize the raw responses. Kept SEPARATE
         from the speculative forward so the cycle's blocking fetch (which
@@ -1010,6 +1180,14 @@ class PPOTrainer(TPUTrainer):
         lp, v, lr, mean_kl = fwd_fn(
             self.ref_params, samples, out["h_split"], out["logprobs"], out["values"]
         )
+        if self._trunk_cache_available():
+            # hand the captured activations onward instead of discarding
+            # them after fast scoring: the cycle attaches them to the
+            # chunk once the spec hit confirms raw == retokenized, so the
+            # fast-rollout schedule pays zero extra forwards for the
+            # trunk cache. Side channel on `out` — the 5-tuple return
+            # contract is pinned by test_fast_dispatch_contract_matches_spec.
+            out["trunk_cache"] = out["h_split"]
         return (trimmed, lp, v, lr, mean_kl)
 
     def pipelined_cycle(self, pending=None):
@@ -1209,6 +1387,12 @@ class PPOTrainer(TPUTrainer):
                     jnp.asarray(prompt_tensors), jnp.asarray(sample_outputs),
                     jnp.asarray(scores_eff), jnp.float32(self.kl_ctl.value),
                 )
+            # Trunk cache: reuse the sampler's captured h_split on a fast
+            # spec hit (raw == retokenized, so the rows align 1:1 with the
+            # chunk); otherwise one jitted trunk pass. No-op when gated off.
+            chunk = self._attach_trunk_cache(
+                chunk, captured=out.get("trunk_cache") if spec_hit else None
+            )
             chunks.append(chunk)
             kl_handles.append(mean_kl)
 

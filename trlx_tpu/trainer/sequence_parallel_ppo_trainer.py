@@ -94,6 +94,22 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             )
         return False
 
+    def _trunk_cache_available(self) -> bool:
+        """The trunk cache is unavailable here: the train loss runs inside
+        a shard_map over the sequence axis, and the cached-split resume
+        lives outside that layout — the full-forward loss stays in charge."""
+        if (
+            getattr(self.config.method, "cache_trunk_activations", False)
+            and not getattr(self, "_warned_no_trunk_cache", False)
+        ):
+            self._warned_no_trunk_cache = True
+            logger.warning(
+                "method.cache_trunk_activations is ignored under sequence "
+                "parallelism (sharded loss cannot consume the cached split "
+                "activations); training with the full forward"
+            )
+        return False
+
     # ------------------------------------------------------------------
     # Shared shard_map forward: per-position logprobs (+values, +ref)
     # ------------------------------------------------------------------

@@ -12,6 +12,12 @@ docs/serving.md):
     # smoke-serve a random tiny model
     python examples/serve_policy.py '{"checkpoint": "random:gpt2-tiny"}'
 
+    # a local rollout fleet: N replicas on consecutive ports sharing one
+    # set of weights, plus the train.rollout_* config snippet to paste
+    # into the trainer that will generate through them (docs/serving.md)
+    python examples/serve_policy.py '{"checkpoint": "random:gpt2-tiny",
+                                      "replicas": 3}'
+
     # then, from anywhere:
     curl -s localhost:8600/generate -d '{"prompt": "hello", "max_new_tokens": 32}'
     curl -s localhost:8600/healthz
@@ -41,6 +47,7 @@ def main(hparams=None):
     port = int(hparams.pop("port", 8600))
     watch_dir = hparams.pop("watch_dir", None)
     background = hparams.pop("background", False)  # tests set this
+    replicas = int(hparams.pop("replicas", 1))
 
     config = default_sft_config().evolve(
         model=dict(model_path=checkpoint),
@@ -57,6 +64,37 @@ def main(hparams=None):
     trainer = SFTTrainer(config)
     if resume:
         trainer.load(resume)
+
+    if replicas > 1:
+        # one process, N independent server replicas (engine + scheduler
+        # each) on consecutive ports (port 0 = OS-assigned for each) —
+        # the smallest real fleet a ReplicaRouter can exercise
+        # failover/hedging against
+        servers = [
+            trainer.serve(port=port + i if port else 0, background=True)
+            for i in range(replicas)
+        ]
+        urls = [s.url for s in servers]
+        snippet = {
+            "train": {
+                "rollout_backend": "fleet",
+                "rollout_fleet_urls": urls,
+                "rollout_max_staleness_steps": 1,
+            }
+        }
+        print(f"Serving {replicas} replicas: {', '.join(urls)}")
+        print("Trainer config for these replicas (TRLConfig.evolve / hparams):")
+        print(json.dumps(snippet, indent=2))
+        if background:
+            return servers
+        try:
+            while True:
+                servers[0]._thread.join(3600)
+        except KeyboardInterrupt:
+            for s in servers:
+                s.shutdown()
+        return servers
+
     return trainer.serve(background=background)
 
 

@@ -1,0 +1,448 @@
+"""Rollout fleet tests (trlx_tpu/inference/fleet.py + PPO wiring).
+
+The failure matrix the ReplicaRouter must survive — replica kill, hang,
+slow decode, stale checkpoint, whole-fleet-down — is driven
+deterministically through `resilience.FaultInjector`, against real
+in-process `InferenceServer` replicas (same engines PR 2 pinned as
+greedy-bit-identical to `trainer.generate`, so fleet rollouts can be
+compared element-for-element against the local path).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.inference import ReplicaRouter, remote_generate
+from trlx_tpu.inference.fleet import FleetUnavailableError
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+MAX_NEW = 4
+# printable bytes + eos: keeps the decode->re-encode round trip exact so
+# behavior logprobs land (same suppress idiom as the fast-path tests)
+SUPPRESS = [i for i in range(259) if not (32 <= i < 127 or i == 258)]
+GEN = dict(max_new_tokens=MAX_NEW, do_sample=False, suppress_tokens=SUPPRESS)
+PROMPTS = ["hello world", "jax tpu", "ppo", "fleet"] * 2
+# short printable-byte prompts for direct router calls
+ID_PROMPTS = [[72, 101, 108, 108], [106, 97, 120], [112, 112, 111], [102, 108]]
+
+REWARD_FN = lambda samples, **kw: [float(len(s)) for s in samples]  # noqa: E731
+
+
+def _config(tmp_path, **train_over):
+    return default_ppo_config().evolve(
+        # float32: greedy engine-vs-trainer bit-identity (PR 2) and the
+        # scorer parity below both assume f32 numerics
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, total_steps=4, tracker=None,
+                   checkpoint_dir=str(tmp_path), seed=11, **train_over),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(GEN)),
+        inference=dict(num_slots=4, max_prompt_len=32, max_new_tokens=MAX_NEW,
+                       max_wait_s=0.0),
+    )
+
+
+def _make_trainer(tmp_path, reward_fn=REWARD_FN, **train_over):
+    trainer = PPOTrainer(_config(tmp_path, **train_over), reward_fn=reward_fn)
+    pipeline = PromptPipeline(PROMPTS, max_prompt_length=8,
+                              tokenizer=trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    return trainer
+
+
+@pytest.fixture(scope="module")
+def server_trainer(tmp_path_factory):
+    """The trainer replicas serve from — same config+seed as the local
+    trainers below, so its params (and greedy outputs) are identical."""
+    return PPOTrainer(_config(tmp_path_factory.mktemp("fleet_srv")),
+                      reward_fn=REWARD_FN)
+
+
+@pytest.fixture(scope="module")
+def pair(server_trainer):
+    """Two warm replicas shared by the router-level tests (tests set
+    fault injectors and must reset them; nobody kills these)."""
+    servers = [
+        server_trainer.serve(host="127.0.0.1", port=0, background=True)
+        for _ in range(2)
+    ]
+    for s in servers:  # warm the jitted prefill/decode before any timing
+        remote_generate(s.url)(ID_PROMPTS[0], max_new_tokens=MAX_NEW)
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+def _router(servers, **kw):
+    kw.setdefault("replica_retries", 0)
+    kw.setdefault("retry_base_delay", 0.05)
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("breaker_recovery", 0.5)
+    kw.setdefault("hedge", False)
+    kw.setdefault("probe_timeout_s", 2.0)
+    return ReplicaRouter([s.url for s in servers], **kw)
+
+
+def _local_greedy(trainer, prompt_ids):
+    out = trainer.generate(
+        np.asarray([prompt_ids], np.int32), np.ones((1, len(prompt_ids)), np.int32),
+        gen_kwargs=dict(GEN),
+    )
+    toks = np.asarray(out["response_tokens"])[0]
+    mask = np.asarray(out["response_mask"])[0]
+    return toks[mask > 0].tolist()
+
+
+# ----------------------------------------------------------------------
+# Router: failover, hedging, staleness
+# ----------------------------------------------------------------------
+
+
+def test_router_failover_on_faulty_replica(server_trainer, pair):
+    """A replica answering only 503s: every request fails over to the
+    healthy replica, nothing is dropped, outputs stay correct, and the
+    faulty replica's breaker opens."""
+    router = _router(pair)
+    pair[0].fault_injector = resilience.FaultInjector(rate=1.0, mode="http_500")
+    try:
+        results = router.generate(ID_PROMPTS, max_new_tokens=MAX_NEW)
+        assert len(results) == len(ID_PROMPTS)
+        for p, res in zip(ID_PROMPTS, results):
+            assert res["token_ids"] == _local_greedy(server_trainer, p)
+        stats = router.stats()
+        assert stats["failovers"] >= 1
+        reps = {r["url"]: r for r in stats["replicas"]}
+        assert reps[pair[0].url]["served"] == 0
+        assert reps[pair[1].url]["served"] == len(ID_PROMPTS)
+        # enough consecutive failures to trip the per-replica breaker
+        assert router.replicas[0].breaker.state in ("open", "half-open")
+    finally:
+        pair[0].fault_injector = None
+        router.close()
+
+
+def test_hedged_request_beats_slow_replica(pair):
+    """Slow-decode fault on the first-choice replica: the hedge fires
+    after `hedge_after_s` and the fast replica's answer wins well before
+    the slow one would have finished."""
+    slow_s = 2.5
+    router = _router(pair, hedge=True, hedge_after_s=0.2)
+    pair[0].fault_injector = resilience.FaultInjector(
+        rate=1.0, mode="slow", slow_s=slow_s
+    )
+    try:
+        t0 = time.monotonic()
+        res = router.generate_one(ID_PROMPTS[0], max_new_tokens=MAX_NEW)
+        elapsed = time.monotonic() - t0
+        assert res["finish_reason"] in ("eos", "length")
+        assert elapsed < slow_s - 0.5, f"hedge did not win ({elapsed:.2f}s)"
+        stats = router.stats()
+        assert stats["hedges"] >= 1
+        assert stats["hedges_cancelled"] + stats["hedges_wasted"] >= 1
+    finally:
+        pair[0].fault_injector = None
+        router.close()
+
+
+def test_stale_replica_refused_until_reload(pair):
+    """Bounded staleness: a replica reporting checkpoint_step too far
+    behind the trainer receives no new requests; once it reports a fresh
+    step (reload) it becomes eligible again."""
+    router = _router(pair, max_staleness_steps=1)
+    # replica 0 claims to serve step-0 weights while the trainer is at 5
+    pair[0].fault_injector = resilience.FaultInjector(stale_checkpoint_step=0)
+    try:
+        router.set_trainer_step(5)
+        router.probe_all(force=True)
+        assert not router._eligible(router.replicas[0])
+        assert router._eligible(router.replicas[1])
+
+        results = router.generate(ID_PROMPTS, max_new_tokens=MAX_NEW)
+        assert all(r["finish_reason"] in ("eos", "length") for r in results)
+        reps = {r["url"]: r for r in router.stats()["replicas"]}
+        assert reps[pair[0].url]["served"] == 0, "stale replica got traffic"
+        assert reps[pair[1].url]["served"] == len(ID_PROMPTS)
+
+        # the replica hot-reloads (simulated: it now reports step 5)
+        pair[0].fault_injector = resilience.FaultInjector(stale_checkpoint_step=5)
+        router.probe_all(force=True)
+        assert router._eligible(router.replicas[0])
+    finally:
+        pair[0].fault_injector = None
+        router.close()
+
+
+# ----------------------------------------------------------------------
+# Server: readiness split + drain-on-sync
+# ----------------------------------------------------------------------
+
+
+def test_drain_on_sync_and_readiness(server_trainer, tmp_path):
+    """Checkpoint hot-reload drains in-flight requests before swapping
+    params (no request mixes two checkpoints), and /healthz readiness is
+    off for the whole reload window while liveness stays on."""
+    from trlx_tpu.inference import InferenceEngine, InferenceServer, Scheduler
+    from trlx_tpu.ops.sampling import GenerationConfig
+
+    tok = server_trainer.tokenizer
+    long_new = 256
+    gen_cfg = GenerationConfig(
+        max_new_tokens=long_new, do_sample=False,
+        eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+        suppress_tokens=tuple(SUPPRESS + [tok.eos_token_id]),  # force full length
+    )
+    engine = InferenceEngine(
+        server_trainer.model, server_trainer.model_cfg, server_trainer.params,
+        gen_cfg, num_slots=2, max_prompt_len=32,
+    )
+    sched = Scheduler(engine, max_wait_s=0.0)
+    ckpt_dir = tmp_path / "ckpts"
+    server = InferenceServer(sched, tokenizer=tok, host="127.0.0.1", port=0,
+                             watch_dir=str(ckpt_dir), reload_interval_s=3600)
+    url = server.start_background()
+    try:
+        remote_generate(url)(ID_PROMPTS[0], max_new_tokens=2)  # warm compile
+        assert server.ready is True
+
+        server_trainer.iter_count = 3
+        server_trainer.save(str(ckpt_dir / "checkpoint_03"))
+
+        record = {}
+        watcher = server.watcher
+        orig_loader, orig_set = watcher.loader, engine.set_params
+
+        def loader(path):
+            params = orig_loader(path)
+            # hold the swap until the long request is mid-flight, so the
+            # drain below has something real to wait for
+            deadline = time.monotonic() + 30
+            while not sched._slot_req and time.monotonic() < deadline:
+                time.sleep(0.005)
+            record["inflight_at_load"] = len(sched._slot_req)
+            record["ready_during_reload"] = server.ready
+            health = json.loads(
+                urllib.request.urlopen(url + "/healthz", timeout=10).read()
+            )
+            record["health_during_reload"] = health
+            return params
+
+        def set_params(params):
+            record["inflight_at_swap"] = len(sched._slot_req)
+            return orig_set(params)
+
+        watcher.loader, engine.set_params = loader, set_params
+
+        result = {}
+        req_thread = threading.Thread(
+            target=lambda: result.update(
+                remote_generate(url, timeout=120)(ID_PROMPTS[1], max_new_tokens=long_new)
+            )
+        )
+        req_thread.start()
+        assert watcher.poll_once() is True
+        req_thread.join(timeout=120)
+
+        assert record["inflight_at_load"] == 1, "long request never got a slot"
+        assert record["inflight_at_swap"] == 0, "params swapped before drain finished"
+        assert record["ready_during_reload"] is False
+        h = record["health_during_reload"]
+        assert h["live"] is True and h["ready"] is False
+        assert h["status"] == "degraded" and h["reloading"] is True
+
+        # the drained request completed normally, full length
+        assert result.get("finish_reason") == "length"
+        assert len(result["token_ids"]) == long_new
+        assert watcher.reloads == 1
+        assert server.ready is True
+        health = json.loads(
+            urllib.request.urlopen(url + "/healthz", timeout=10).read()
+        )
+        assert health["status"] == "ok" and health["ready"] is True
+        assert health["checkpoint_step"] == 3
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# PPO wiring: bit-identity, behavior logprobs, chaos, degrade
+# ----------------------------------------------------------------------
+
+
+def test_apply_behavior_logprobs_rows(server_trainer):
+    """Rows overwrite only where the retokenized response round-tripped
+    exactly; mismatched rows keep the trainer-side logprobs."""
+    pad = server_trainer.tokenizer.pad_token_id
+    plen = 4
+    prompt_tensors = np.full((2, plen), 65, np.int32)
+    sample_outputs = np.array([[10, 11, pad], [20, 21, 22]], np.int32)
+    out = {
+        "response_tokens": np.array([[10, 11, pad], [20, 99, 22]], np.int32),
+        "response_mask": np.array([[1, 1, 0], [1, 1, 1]], np.int32),
+        "behavior_logprobs": np.array(
+            [[-1.0, -2.0, 0.0], [-3.0, -4.0, -5.0]], np.float32
+        ),
+    }
+    logprobs = np.zeros((2, plen + 3 - 1), np.float32)
+    hits = server_trainer._apply_behavior_logprobs(
+        logprobs, out, prompt_tensors, sample_outputs
+    )
+    assert hits == 1
+    start = plen - 1
+    assert logprobs[0, start : start + 2].tolist() == [-1.0, -2.0]
+    assert np.all(logprobs[1] == 0.0), "mismatched row must not be overwritten"
+
+
+def _assert_stores_equal(a, b, logprob_atol=None):
+    assert len(a.history) == len(b.history)
+    for ea, eb in zip(a.history, b.history):
+        assert np.array_equal(ea.query_tensor, eb.query_tensor)
+        assert np.array_equal(ea.response_tensor, eb.response_tensor)
+        assert np.array_equal(np.asarray(ea.values), np.asarray(eb.values))
+        assert np.array_equal(np.asarray(ea.rewards), np.asarray(eb.rewards))
+        if logprob_atol is None:
+            assert np.array_equal(np.asarray(ea.logprobs), np.asarray(eb.logprobs))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(ea.logprobs), np.asarray(eb.logprobs), atol=logprob_atol
+            )
+
+
+@pytest.fixture(scope="module")
+def local_store(tmp_path_factory):
+    """Reference store: explicit rollout_backend='local'."""
+    trainer = _make_trainer(tmp_path_factory.mktemp("fleet_local"),
+                            rollout_backend="local")
+    trainer.make_experience(12)
+    return trainer
+
+
+def test_local_default_bit_identity(tmp_path, local_store):
+    """The default (no rollout_backend set) is bit-identical to the
+    explicit 'local' backend — the fleet wiring changes nothing when
+    off, and no router is ever built."""
+    trainer = _make_trainer(tmp_path)
+    assert trainer._fleet_rollouts_enabled() is False
+    trainer.make_experience(12)
+    _assert_stores_equal(trainer.store, local_store.store)
+    assert trainer._rollout_router is None
+    assert local_store._rollout_router is None
+
+
+def test_fleet_chaos_kill_mid_rollout_and_parity(tmp_path, tmp_path_factory,
+                                                 server_trainer, local_store):
+    """The acceptance chaos test: 3 replicas, one killed mid-
+    make_experience (after the first chunk's rewards) — the cycle still
+    yields the exact requested rollout count with zero dropped prompts,
+    element-for-element equal to the local store (logprobs to decode-vs-
+    batched tolerance: they are the replicas' behavior logprobs), and a
+    finite PPO loss."""
+    servers = [
+        server_trainer.serve(host="127.0.0.1", port=0, background=True)
+        for _ in range(3)
+    ]
+    killed = []
+
+    def killing_reward(samples, **kw):
+        if not killed:
+            killed.append(True)
+            resilience.FaultInjector.kill_replica(servers[2])
+        return REWARD_FN(samples, **kw)
+
+    trainer = _make_trainer(
+        tmp_path_factory.mktemp("fleet_fleet"),
+        reward_fn=killing_reward,
+        rollout_backend="fleet",
+        rollout_fleet_urls=[s.url for s in servers],
+        rollout_fleet_kwargs=dict(
+            replica_retries=0, retry_base_delay=0.05, breaker_threshold=2,
+            breaker_recovery=0.5, hedge=False, probe_timeout_s=2.0,
+        ),
+    )
+    try:
+        trainer.make_experience(12)  # 3 chunks of 4; kill lands after chunk 1
+        assert killed, "kill never fired"
+        assert len(trainer.store.history) == 12, "dropped prompts"
+        assert trainer._rollout_router is not None
+        stats = trainer._rollout_router.stats()
+        assert stats["requests"] >= 12
+
+        # greedy parity with the local path: tokens/rewards/values bitwise,
+        # logprobs within the decode-vs-batched-forward tolerance
+        _assert_stores_equal(trainer.store, local_store.store, logprob_atol=1e-3)
+
+        # finite loss from the fleet-collected store
+        loader = trainer.create_train_dataloader()
+        for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+            train_stats = trainer.train_minibatch(minibatch)
+            break
+        assert np.isfinite(float(np.asarray(train_stats["losses"]["total_loss"])))
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def _dead_url():
+    """A URL that refuses connections (bound then released port)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"http://127.0.0.1:{port}"
+
+
+def test_whole_fleet_down_degrades_to_local(tmp_path):
+    """All replicas unreachable: the cycle completes via local
+    generation with a one-time warning instead of failing."""
+    trainer = _make_trainer(
+        tmp_path,
+        rollout_backend="fleet",
+        rollout_fleet_urls=[_dead_url(), _dead_url()],
+        rollout_fleet_kwargs=dict(
+            timeout=2.0, probe_timeout_s=0.3, replica_retries=0,
+            retry_base_delay=0.01, breaker_threshold=1, hedge=False,
+        ),
+    )
+    trainer.make_experience(4)
+    assert len(trainer.store.history) == 4
+    assert trainer._rollout_router is not None  # fleet was attempted
+    from trlx_tpu.utils.logging import MultiProcessAdapter
+
+    assert any(
+        "degrading to local generation" in str(msg)
+        for (_, msg) in MultiProcessAdapter._once_seen
+    ), "degrade warning was not emitted"
+
+
+@pytest.mark.slow
+def test_fleet_saturation_with_mixed_faults(server_trainer, pair):
+    """Longer soak: a lossy replica (mixed 503 / dropped-connection
+    faults) plus a healthy one under 32 concurrent prompts — every
+    prompt is served with correct greedy output."""
+    router = _router(pair, concurrency=8, breaker_threshold=4,
+                     breaker_recovery=0.2)
+    pair[0].fault_injector = resilience.FaultInjector(
+        rate=0.4, seed=3, mode="mixed"
+    )
+    try:
+        prompts = [ID_PROMPTS[i % len(ID_PROMPTS)] for i in range(32)]
+        results = router.generate(prompts, max_new_tokens=MAX_NEW)
+        assert len(results) == 32
+        want = {tuple(p): None for p in ID_PROMPTS}
+        for p in ID_PROMPTS:
+            want[tuple(p)] = _local_greedy(server_trainer, p)
+        for p, res in zip(prompts, results):
+            assert res["token_ids"] == want[tuple(p)]
+    finally:
+        pair[0].fault_injector = None
+        router.close()

@@ -148,6 +148,132 @@ def test_circuit_breaker_reopens_on_failed_probe():
         br.check()
 
 
+def test_circuit_breaker_half_open_single_probe_under_concurrency():
+    """Half-open admits EXACTLY one probe even when many threads race
+    through check() simultaneously (the fleet router shares one breaker
+    per replica across its whole request pool). The unlocked
+    read-then-set this pins against would admit several."""
+    import threading
+
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, recovery_time=5.0, clock=lambda: clock["t"])
+    br.record_failure()
+    clock["t"] = 6.0  # half-open window
+    n = 32
+    barrier = threading.Barrier(n)
+    admitted = []
+    rejected = []
+
+    def prober():
+        barrier.wait()
+        try:
+            br.check()
+            admitted.append(1)
+        except CircuitOpenError:
+            rejected.append(1)
+
+    threads = [threading.Thread(target=prober) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(admitted) == 1, f"half-open admitted {len(admitted)} probes"
+    assert len(rejected) == n - 1
+
+
+def test_retry_honors_retry_after_hint():
+    """A TransientError carrying the server's Retry-After hint stretches
+    the local backoff to at least the hint (capped at max_delay)."""
+    sleeps = []
+    calls = {"n": 0}
+
+    @retry(retries=3, base_delay=0.01, max_delay=10.0, jitter=0.0, sleep=sleeps.append)
+    def backpressured():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            e = TransientError("503 queue full")
+            e.retry_after = 1.5
+            raise e
+        return "ok"
+
+    assert backpressured() == "ok"
+    # both delays lifted from the 0.01/0.02 schedule to the server's hint
+    assert sleeps == pytest.approx([1.5, 1.5])
+
+
+def test_retry_after_hint_capped_at_max_delay():
+    sleeps = []
+    calls = {"n": 0}
+
+    @retry(retries=1, base_delay=0.01, max_delay=2.0, jitter=0.0, sleep=sleeps.append)
+    def huge_hint():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            e = TransientError("503")
+            e.retry_after = 60.0
+            raise e
+        return "ok"
+
+    assert huge_hint() == "ok"
+    assert sleeps == pytest.approx([2.0])
+
+
+def test_json_client_attaches_retry_after_header(tmp_path):
+    """The shared HTTP client surfaces a 503's Retry-After header as the
+    TransientError's backoff hint, and `retry` then waits (at least) the
+    server-computed interval instead of its own tiny first backoff."""
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from trlx_tpu.utils.http import RetryingJSONClient
+
+    state = {"calls": 0}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            state["calls"] += 1
+            body = _json.dumps(
+                {"error": "queue full"} if state["calls"] == 1 else {"out": 1}
+            ).encode()
+            code = 503 if state["calls"] == 1 else 200
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if code == 503:
+                self.send_header("Retry-After", "7")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    sleeps = []
+    try:
+        client = RetryingJSONClient(
+            f"http://127.0.0.1:{httpd.server_address[1]}/",
+            retries=2, retry_base_delay=0.01, retry_max_delay=30.0,
+            _sleep=sleeps.append,
+        )
+        assert client.post({"x": 1}) == {"out": 1}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert state["calls"] == 2
+    assert sleeps == pytest.approx([7.0])
+
+
+def test_fault_injector_replica_fault_knobs():
+    inj = FaultInjector(rate=0.0, mode="slow", slow_s=0.125, hang_s=3.0,
+                        stale_checkpoint_step=2)
+    assert inj.slow_s == 0.125 and inj.hang_s == 3.0
+    assert inj.stale_checkpoint_step == 2
+    assert inj.should_fail() is False  # rate 0: knobs don't inject by themselves
+
+
 # ----------------------------------------------------------------------
 # atomic checkpoints + manifest + retention
 # ----------------------------------------------------------------------

@@ -305,6 +305,23 @@ class TrainConfig:
     # are unchanged. Implies fuse_inner_epoch.
     fuse_all_inner_epochs: bool = False
 
+    # Disaggregated rollouts (trlx_tpu/inference/fleet.py). "local"
+    # (default): make_experience generates on the trainer as always —
+    # bit-identical to the pre-fleet behavior. "fleet": prompts fan out
+    # to the `rollout_fleet_urls` inference replicas through a
+    # ReplicaRouter (health probes, per-replica circuit breakers,
+    # failover, hedging, bounded staleness); per-token behavior-policy
+    # logprobs come back from the replicas' decode path. If the whole
+    # fleet is down, the cycle degrades to local generation with a
+    # one-time warning rather than failing.
+    rollout_backend: str = "local"  # "local" | "fleet"
+    rollout_fleet_urls: List[str] = field(default_factory=list)
+    # Replicas reporting checkpoint_step more than this many trainer
+    # steps behind receive no new requests until they hot-reload.
+    rollout_max_staleness_steps: int = 1
+    # Extra ReplicaRouter kwargs (timeout, hedge_after_s, concurrency...).
+    rollout_fleet_kwargs: Dict[str, Any] = field(default_factory=dict)
+
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
         return cls(**config)
@@ -392,6 +409,7 @@ class TRLConfig:
         open_dicts = {
             "kwargs", "gen_kwargs", "gen_experience_kwargs",
             "trainer_kwargs", "model_extra_configs", "peft_config",
+            "rollout_fleet_kwargs",
         }
 
         def _check_keys(base: Dict, upd: Dict, prefix: str = ""):

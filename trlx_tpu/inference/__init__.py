@@ -3,14 +3,18 @@
 - `engine` — continuous-batching `InferenceEngine` over a slot-based
   KV-cache pool (jitted prefill / decode_step);
 - `scheduler` — FIFO admission, max-wait batching, bounded queue with
-  backpressure, per-request deadlines;
-- `server` — HTTP `POST /generate` + `/healthz` + Prometheus `/metrics`,
-  checkpoint hot-reload;
-- `client` — `remote_generate` on the shared retry/circuit-breaker stack.
+  backpressure, per-request deadlines, drain for weight sync;
+- `server` — HTTP `POST /generate` + `/healthz` (liveness/readiness) +
+  Prometheus `/metrics`, drain-on-sync checkpoint hot-reload;
+- `client` — `remote_generate` on the shared retry/circuit-breaker stack;
+- `fleet` — `ReplicaRouter` fronting N replicas: health probes, per-replica
+  circuit breakers, least-loaded dispatch with failover, hedged requests,
+  bounded-staleness weight sync, whole-fleet-down degradation signal.
 """
 
 from trlx_tpu.inference.client import remote_generate
 from trlx_tpu.inference.engine import InferenceEngine
+from trlx_tpu.inference.fleet import FleetUnavailableError, Replica, ReplicaRouter
 from trlx_tpu.inference.metrics import InferenceMetrics
 from trlx_tpu.inference.scheduler import InferenceRequest, QueueFullError, Scheduler
 from trlx_tpu.inference.server import (
@@ -21,11 +25,14 @@ from trlx_tpu.inference.server import (
 
 __all__ = [
     "CheckpointWatcher",
+    "FleetUnavailableError",
     "InferenceEngine",
     "InferenceMetrics",
     "InferenceRequest",
     "InferenceServer",
     "QueueFullError",
+    "Replica",
+    "ReplicaRouter",
     "Scheduler",
     "load_checkpoint_params",
     "remote_generate",

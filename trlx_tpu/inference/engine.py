@@ -164,6 +164,13 @@ class InferenceEngine:
     def param_version(self) -> int:
         return self._param_version
 
+    @property
+    def has_params(self) -> bool:
+        """Whether the engine holds weights at all (readiness: a server
+        started ahead of its first checkpoint must report not-ready)."""
+        with self._param_lock:
+            return self._params is not None
+
     def _current_params(self):
         with self._param_lock:
             return self._params

@@ -1,0 +1,483 @@
+"""Fault-tolerant rollout fleet: a router fronting N inference replicas.
+
+Everything below `remote_generate` assumes exactly one server URL and a
+replica that never dies. Production rollouts need the opposite: a pool
+of `InferenceServer` replicas where any member can be preempted, hang,
+decode slowly, or serve a stale checkpoint — without a rollout cycle
+ever dropping a prompt. `ReplicaRouter` is that robustness layer:
+
+- **health probes with a liveness/readiness split** — each replica's
+  ``GET /healthz`` is polled (lazily, at most every `probe_interval_s`);
+  ``live`` answers "the process is up", ``ready`` answers "it can take
+  traffic now" (false while a checkpoint reload is in flight — the
+  server drains and swaps behind the same flag). Probe failures mark the
+  replica down until a later probe resurrects it.
+- **per-replica circuit breakers + least-loaded dispatch + failover** —
+  every replica sits behind its own `RetryingJSONClient` (small
+  per-replica retry budget, its own `CircuitBreaker`). Dispatch picks
+  the eligible replica with the fewest in-flight requests; a request
+  that fails or times out is retried on the *next* eligible replica
+  (each replica is attempted at most once per request), so a request is
+  never silently dropped while any replica can serve it.
+- **hedged requests** — after a p95-derived delay (or a fixed
+  `hedge_after_s`), a still-pending request is duplicated onto a second
+  replica; the first answer wins and the loser is cancelled (when not
+  yet started) or abandoned (an in-flight HTTP request cannot be
+  aborted; its result is discarded and counted in `hedges_wasted`).
+- **bounded-staleness weight sync** — the router tracks each replica's
+  ``checkpoint_step`` (from /healthz and from every /generate reply)
+  against `set_trainer_step`. A replica more than `max_staleness_steps`
+  behind receives no new requests until it reloads, and a reply that
+  arrives stale (the replica reloaded backwards mid-request) is rejected
+  and re-dispatched — rollouts from beyond the staleness bound are never
+  mixed into a chunk. Replicas that report no checkpoint_step (serving
+  live in-process params, no watcher) are exempt.
+- **whole-fleet-down degradation** — when no replica can serve a
+  request, `FleetUnavailableError` is raised; the PPO trainer catches it
+  and degrades to local `trainer.generate` with a one-time warning.
+
+Thread safety: `generate` fans prompts out over an internal coordinator
+pool; HTTP posts run on a separate request pool (so hedges can never
+deadlock the coordinators). All replica bookkeeping happens under one
+router lock.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from collections import deque
+from concurrent import futures
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from trlx_tpu import resilience
+from trlx_tpu.utils import logging
+from trlx_tpu.utils.http import RetryingJSONClient
+
+logger = logging.get_logger(__name__)
+
+
+class FleetUnavailableError(RuntimeError):
+    """No replica in the fleet could serve a request: every eligible
+    replica was tried and failed, or none is live/ready/fresh. Callers
+    degrade (the PPO trainer falls back to local generation)."""
+
+
+class Replica:
+    """One fleet member: its URL, retry/breaker client, and the router's
+    view of its health (updated by probes and dispatch outcomes)."""
+
+    def __init__(
+        self,
+        url: str,
+        timeout: float = 300.0,
+        retries: int = 1,
+        retry_base_delay: float = 0.1,
+        retry_max_delay: float = 2.0,
+        breaker_threshold: int = 3,
+        breaker_recovery: float = 10.0,
+        _sleep=None,
+    ):
+        self.url = url.rstrip("/")
+        self.client = RetryingJSONClient(
+            self.url + "/generate",
+            timeout=timeout,
+            retries=retries,
+            retry_base_delay=retry_base_delay,
+            retry_max_delay=retry_max_delay,
+            breaker_threshold=breaker_threshold,
+            breaker_recovery=breaker_recovery,
+            error_label=f"replica {self.url}",
+            _sleep=_sleep,
+        )
+        # optimistic until the first probe says otherwise: a router built
+        # before its replicas finish binding should not blacklist them
+        self.live = True
+        self.ready = True
+        self.draining = False
+        self.checkpoint_step: Optional[int] = None
+        self.param_version: Optional[int] = None
+        self.inflight = 0
+        self.served = 0
+        self.failures = 0
+        self.last_probe = 0.0  # monotonic; 0 = never probed
+        self.last_error: Optional[str] = None
+
+    @property
+    def breaker(self) -> resilience.CircuitBreaker:
+        return self.client.breaker
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "url": self.url,
+            "live": self.live,
+            "ready": self.ready,
+            "draining": self.draining,
+            "checkpoint_step": self.checkpoint_step,
+            "breaker": self.breaker.state,
+            "inflight": self.inflight,
+            "served": self.served,
+            "failures": self.failures,
+            "last_error": self.last_error,
+        }
+
+
+class ReplicaRouter:
+    """Route generation requests across a fleet of inference replicas.
+
+    `generate(prompts, **kw)` returns one response dict per prompt, in
+    order, or raises `FleetUnavailableError` when any prompt cannot be
+    served by any replica (all-or-nothing per chunk: a partial chunk
+    would silently shrink the rollout count). Per-call kwargs mirror
+    `remote_generate` (`max_new_tokens`, `deadline_s`); sampling knobs
+    are fixed at replica start.
+
+    :param urls: base URLs of the `InferenceServer` replicas.
+    :param max_staleness_steps: a replica whose `checkpoint_step` is more
+        than this far behind `set_trainer_step` receives no new requests
+        until it reloads; replicas reporting no step are exempt.
+    :param hedge_after_s: fixed hedging delay; None derives it from the
+        p95 of the last `hedge_min_samples`+ request latencies (no
+        hedging until that many samples exist).
+    :param concurrency: prompts dispatched at once by `generate`.
+    """
+
+    def __init__(
+        self,
+        urls: Sequence[str],
+        timeout: float = 300.0,
+        concurrency: int = 8,
+        max_staleness_steps: int = 1,
+        probe_interval_s: float = 2.0,
+        probe_timeout_s: float = 5.0,
+        replica_retries: int = 1,
+        retry_base_delay: float = 0.1,
+        retry_max_delay: float = 2.0,
+        breaker_threshold: int = 3,
+        breaker_recovery: float = 10.0,
+        hedge: bool = True,
+        hedge_after_s: Optional[float] = None,
+        hedge_min_samples: int = 16,
+        hedge_max_delay_s: float = 5.0,
+        _sleep=None,
+    ):
+        if not urls:
+            raise ValueError("ReplicaRouter needs at least one replica URL")
+        self.replicas = [
+            Replica(
+                u,
+                timeout=timeout,
+                retries=replica_retries,
+                retry_base_delay=retry_base_delay,
+                retry_max_delay=retry_max_delay,
+                breaker_threshold=breaker_threshold,
+                breaker_recovery=breaker_recovery,
+                _sleep=_sleep,
+            )
+            for u in urls
+        ]
+        self.max_staleness_steps = int(max_staleness_steps)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.hedge = bool(hedge)
+        self.hedge_after_s = hedge_after_s
+        self.hedge_min_samples = int(hedge_min_samples)
+        self.hedge_max_delay_s = float(hedge_max_delay_s)
+        self.trainer_step: Optional[int] = None
+        self.counters: Dict[str, int] = {
+            "requests": 0,
+            "failovers": 0,
+            "hedges": 0,
+            "hedges_cancelled": 0,
+            "hedges_wasted": 0,
+            "stale_rejected": 0,
+        }
+        self._lock = threading.Lock()
+        self._latencies: deque = deque(maxlen=256)
+        n = max(int(concurrency), 1)
+        self._coordinators = futures.ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="trlx-tpu-fleet-coord"
+        )
+        # hedges double the worst-case posts in flight; a separate pool
+        # keeps them from starving (or deadlocking) the coordinators
+        self._requests = futures.ThreadPoolExecutor(
+            max_workers=2 * n + 2, thread_name_prefix="trlx-tpu-fleet-req"
+        )
+
+    # ------------------------------------------------------------------
+    # Health probing
+    # ------------------------------------------------------------------
+
+    def probe(self, rep: Replica) -> bool:
+        """One /healthz round trip; updates live/ready/checkpoint_step.
+        Legacy replicas without the readiness split count as ready while
+        their status is "ok"."""
+        try:
+            with urllib.request.urlopen(
+                rep.url + "/healthz", timeout=self.probe_timeout_s
+            ) as resp:
+                info = json.loads(resp.read())
+        except Exception as e:  # connection refused/reset, timeout, bad body
+            rep.live = False
+            rep.ready = False
+            rep.last_error = f"probe: {e}"
+            rep.last_probe = time.monotonic()
+            return False
+        rep.live = bool(info.get("live", info.get("status") == "ok"))
+        rep.ready = bool(info.get("ready", rep.live))
+        step = info.get("checkpoint_step")
+        rep.checkpoint_step = int(step) if step is not None else None
+        rep.param_version = info.get("param_version")
+        rep.last_probe = time.monotonic()
+        rep.last_error = None
+        return rep.live
+
+    def probe_all(self, force: bool = False) -> int:
+        """Probe every replica whose last probe is older than
+        `probe_interval_s` (all of them with `force`); returns how many
+        are live AND ready afterwards."""
+        now = time.monotonic()
+        n_up = 0
+        for rep in self.replicas:
+            if force or rep.last_probe == 0.0 or now - rep.last_probe >= self.probe_interval_s:
+                self.probe(rep)
+            n_up += int(rep.live and rep.ready)
+        return n_up
+
+    # ------------------------------------------------------------------
+    # Eligibility + dispatch choice
+    # ------------------------------------------------------------------
+
+    def set_trainer_step(self, step: Optional[int]) -> None:
+        """Anchor the staleness bound: replicas more than
+        `max_staleness_steps` behind this step become ineligible."""
+        self.trainer_step = None if step is None else int(step)
+
+    def _fresh_step(self, checkpoint_step: Optional[int]) -> bool:
+        if checkpoint_step is None or self.trainer_step is None:
+            return True  # unversioned replica (live params) / unanchored router
+        return self.trainer_step - int(checkpoint_step) <= self.max_staleness_steps
+
+    def _eligible(self, rep: Replica) -> bool:
+        return (
+            rep.live
+            and rep.ready
+            and not rep.draining
+            and rep.breaker.state != "open"
+            and self._fresh_step(rep.checkpoint_step)
+        )
+
+    def _pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
+        """Least-loaded dispatch among eligible replicas (ties broken by
+        fewest lifetime requests, then list order)."""
+        with self._lock:
+            candidates = [
+                (rep.inflight, rep.served, i, rep)
+                for i, rep in enumerate(self.replicas)
+                if rep not in exclude and self._eligible(rep)
+            ]
+        if not candidates:
+            return None
+        return min(candidates)[3]
+
+    # ------------------------------------------------------------------
+    # Request path
+    # ------------------------------------------------------------------
+
+    def _post(self, rep: Replica, payload: Dict) -> Dict:
+        """One breaker-guarded post to one replica, with inflight/latency
+        bookkeeping (runs on the request pool; exceptions propagate)."""
+        with self._lock:
+            rep.inflight += 1
+        t0 = time.monotonic()
+        try:
+            out = rep.client.post(dict(payload))
+        except Exception as e:
+            with self._lock:
+                rep.inflight -= 1
+                rep.failures += 1
+                rep.last_error = str(e)
+            raise
+        dt = time.monotonic() - t0
+        with self._lock:
+            rep.inflight -= 1
+            rep.served += 1
+            self._latencies.append(dt)
+        return out
+
+    def _hedge_delay(self) -> Optional[float]:
+        """Seconds to wait before duplicating a pending request, or None
+        for no hedging (disabled, or not enough latency samples yet)."""
+        if not self.hedge:
+            return None
+        if self.hedge_after_s is not None:
+            return float(self.hedge_after_s)
+        with self._lock:
+            if len(self._latencies) < self.hedge_min_samples:
+                return None
+            lat = sorted(self._latencies)
+        p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+        return min(p95, self.hedge_max_delay_s)
+
+    def generate_one(self, prompt: Union[str, List[int]], **kwargs) -> Dict:
+        """Serve one prompt with failover + hedging. Raises
+        `FleetUnavailableError` only after every eligible replica has
+        been attempted (and one forced re-probe found nothing new)."""
+        payload = dict(kwargs)
+        if isinstance(prompt, str):
+            payload["prompt"] = prompt
+        else:
+            payload["prompt_ids"] = list(map(int, prompt))
+        with self._lock:
+            self.counters["requests"] += 1
+
+        tried: List[Replica] = []
+        reprobed = False
+        last_exc: Optional[BaseException] = None
+        while True:
+            rep = self._pick(exclude=tried)
+            if rep is None and not reprobed:
+                # a replica may have recovered (or finished reloading)
+                # since its last probe — one forced pass before giving up
+                reprobed = True
+                if self.probe_all(force=True):
+                    rep = self._pick(exclude=tried)
+            if rep is None:
+                raise FleetUnavailableError(
+                    f"no eligible replica (tried {[r.url for r in tried] or 'none'};"
+                    f" last error: {last_exc})"
+                )
+
+            pending: Dict[futures.Future, Replica] = {
+                self._requests.submit(self._post, rep, payload): rep
+            }
+            tried.append(rep)
+
+            delay = self._hedge_delay()
+            if delay is not None:
+                done, _ = futures.wait(
+                    set(pending), timeout=delay, return_when=futures.FIRST_COMPLETED
+                )
+                if not done:
+                    hedge_rep = self._pick(exclude=tried)
+                    if hedge_rep is not None:
+                        pending[self._requests.submit(self._post, hedge_rep, payload)] = hedge_rep
+                        tried.append(hedge_rep)
+                        with self._lock:
+                            self.counters["hedges"] += 1
+
+            outstanding = set(pending)
+            while outstanding:
+                done, outstanding = futures.wait(
+                    outstanding, return_when=futures.FIRST_COMPLETED
+                )
+                winner = None
+                for fut in done:
+                    rep_f = pending[fut]
+                    try:
+                        out = fut.result()
+                    except (resilience.TransientError, resilience.CircuitOpenError) as e:
+                        last_exc = e
+                        with self._lock:
+                            self.counters["failovers"] += 1
+                        continue
+                    if not self._fresh_step(out.get("checkpoint_step")):
+                        # the replica reloaded to (or reported) a
+                        # checkpoint beyond the staleness bound mid-flight:
+                        # never mix this rollout in — re-dispatch
+                        last_exc = resilience.TransientError(
+                            f"stale rollout from {rep_f.url} (checkpoint_step "
+                            f"{out.get('checkpoint_step')} vs trainer step "
+                            f"{self.trainer_step})"
+                        )
+                        with self._lock:
+                            self.counters["stale_rejected"] += 1
+                        self.probe(rep_f)  # refresh its step so _pick skips it
+                        continue
+                    winner = out
+                    break
+                if winner is not None:
+                    for fut in outstanding:  # the hedging loser
+                        if fut.cancel():
+                            with self._lock:
+                                self.counters["hedges_cancelled"] += 1
+                        else:
+                            # in-flight HTTP cannot be aborted: the reply
+                            # is discarded when it lands
+                            with self._lock:
+                                self.counters["hedges_wasted"] += 1
+                    return winner
+            # every attempt of this round failed -> failover continues
+            # with the replicas not yet tried
+
+    def generate(self, prompts, **kwargs) -> Union[Dict, List[Dict]]:
+        """Serve one prompt or a list of prompts (fanned out over
+        `concurrency` coordinators). All-or-nothing: if any prompt is
+        unservable by the whole fleet, `FleetUnavailableError` carries
+        the count so the caller can degrade for the entire chunk."""
+        single = isinstance(prompts, str) or (
+            isinstance(prompts, (list, tuple))
+            and bool(prompts)
+            and isinstance(prompts[0], int)
+        )
+        self.probe_all()
+        if single:
+            return self.generate_one(prompts, **kwargs)
+        futs = [
+            self._coordinators.submit(self.generate_one, p, **kwargs) for p in prompts
+        ]
+        results: List[Optional[Dict]] = []
+        errors: List[BaseException] = []
+        for fut in futs:
+            try:
+                results.append(fut.result())
+            except FleetUnavailableError as e:
+                results.append(None)
+                errors.append(e)
+        if errors:
+            raise FleetUnavailableError(
+                f"{len(errors)}/{len(prompts)} prompts unservable by the fleet; "
+                f"first: {errors[0]}"
+            )
+        return results
+
+    # ------------------------------------------------------------------
+    # Drain (weight-sync coordination) + introspection
+    # ------------------------------------------------------------------
+
+    def _by_url(self, url: str) -> Replica:
+        url = url.rstrip("/")
+        for rep in self.replicas:
+            if rep.url == url:
+                return rep
+        raise KeyError(f"unknown replica {url}")
+
+    def drain(self, url: str, timeout_s: float = 30.0) -> bool:
+        """Stop dispatching to `url` and wait for its in-flight requests
+        to finish (router-side drain, e.g. before an orchestrated
+        reload). Returns True when fully drained; the replica stays
+        excluded until `undrain`."""
+        rep = self._by_url(url)
+        rep.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if rep.inflight == 0:
+                    return True
+            time.sleep(0.01)
+        with self._lock:
+            return rep.inflight == 0
+
+    def undrain(self, url: str) -> None:
+        self._by_url(url).draining = False
+
+    def stats(self) -> Dict[str, Any]:
+        """Router counters + per-replica snapshots (for logs/tests)."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self.counters)
+        out["replicas"] = [rep.snapshot() for rep in self.replicas]
+        return out
+
+    def close(self) -> None:
+        self._coordinators.shutdown(wait=False)
+        self._requests.shutdown(wait=False)

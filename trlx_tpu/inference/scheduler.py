@@ -93,6 +93,7 @@ class Scheduler:
         self._free: List[int] = list(range(engine.num_slots))
         self._ids = itertools.count()
         self._running = False
+        self._paused = False  # admission gate for drain-on-sync
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -148,6 +149,36 @@ class Scheduler:
         return req
 
     # ------------------------------------------------------------------
+    # Drain (weight-sync coordination)
+    # ------------------------------------------------------------------
+
+    def pause_admission(self) -> None:
+        """Stop moving queued requests into slots. In-flight requests
+        keep decoding to completion; new submits still enqueue (they are
+        admitted on `resume_admission`)."""
+        with self._cond:
+            self._paused = True
+
+    def resume_admission(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Pause admission and wait until every slot is empty. Returns
+        True when fully drained (False on timeout — the caller decides
+        whether to swap anyway). Caller must `resume_admission` after."""
+        self.pause_admission()
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._slot_req:
+                    return True
+            time.sleep(0.005)
+        with self._cond:
+            return not self._slot_req
+
+    # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
 
@@ -190,7 +221,10 @@ class Scheduler:
             with self._cond:
                 if not self._running:
                     return
-                if not self._queue and not self._slot_req:
+                idle = not self._queue and not self._slot_req
+                # paused with nothing in flight: queued requests must
+                # wait for resume_admission, so don't busy-spin on them
+                if idle or (self._paused and not self._slot_req):
                     self._cond.wait(timeout=0.05)
                     continue
             try:
@@ -217,7 +251,7 @@ class Scheduler:
 
     def _admit(self) -> None:
         with self._cond:
-            if not self._queue or not self._free:
+            if self._paused or not self._queue or not self._free:
                 return
             want = min(len(self._free), self.engine.max_prefill_batch)
             oldest_wait = time.monotonic() - self._queue[0].enqueue_time

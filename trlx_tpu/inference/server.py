@@ -64,20 +64,31 @@ def load_checkpoint_params(directory: str) -> Dict:
 class CheckpointWatcher(threading.Thread):
     """Poll `watch_dir` for newer manifest-complete checkpoints and swap
     them into the engine. Truncated/mid-write checkpoints are invisible
-    (no manifest), so a swap is always a complete state."""
+    (no manifest), so a swap is always a complete state.
+
+    With a `scheduler`, each swap is **drain-on-sync**: admission pauses,
+    in-flight requests decode to completion (bounded by
+    `drain_timeout_s`), the params swap, and admission resumes — no
+    request ever mixes tokens from two checkpoints. `reloading` is True
+    for the whole window, which flips the server's `/healthz` readiness
+    off so a fleet router routes around the replica mid-swap."""
 
     def __init__(self, engine, watch_dir: str, interval_s: float = 5.0,
-                 metrics=None, loader=load_checkpoint_params):
+                 metrics=None, loader=load_checkpoint_params,
+                 scheduler=None, drain_timeout_s: float = 30.0):
         super().__init__(name="trlx-tpu-ckpt-watcher", daemon=True)
         self.engine = engine
         self.watch_dir = watch_dir
         self.interval_s = interval_s
         self.metrics = metrics
         self.loader = loader
+        self.scheduler = scheduler
+        self.drain_timeout_s = float(drain_timeout_s)
         self.loaded_step: Optional[int] = None
         self.loaded_path: Optional[str] = None
         self._loaded_key = None  # (path, step, wall_time) of the live params
         self.reloads = 0
+        self.reloading = False  # True while a swap is in flight (readiness off)
         self._stop = threading.Event()
 
     def poll_once(self) -> bool:
@@ -92,12 +103,24 @@ class CheckpointWatcher(threading.Thread):
         key = (path, step, manifest.get("wall_time"))
         if key == self._loaded_key:
             return False
+        self.reloading = True
         try:
-            params = self.loader(path)
-        except Exception as e:
-            logger.warning(f"hot-reload: failed to load {path}: {e}")
-            return False
-        self.engine.set_params(params)
+            try:
+                params = self.loader(path)
+            except Exception as e:
+                logger.warning(f"hot-reload: failed to load {path}: {e}")
+                return False
+            if self.scheduler is not None:
+                if not self.scheduler.drain(self.drain_timeout_s):
+                    logger.warning(
+                        "hot-reload: drain timed out after "
+                        f"{self.drain_timeout_s}s; swapping with requests in flight"
+                    )
+            self.engine.set_params(params)
+        finally:
+            if self.scheduler is not None:
+                self.scheduler.resume_admission()
+            self.reloading = False
         self.loaded_step, self.loaded_path = step, path
         self._loaded_key = key
         self.reloads += 1
@@ -143,10 +166,32 @@ class InferenceServer:
         if watch_dir:
             self.watcher = CheckpointWatcher(
                 self.engine, watch_dir, reload_interval_s, self.metrics,
-                loader=checkpoint_loader,
+                loader=checkpoint_loader, scheduler=self.scheduler,
             )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs liveness): able to take traffic NOW — the engine
+        holds weights and no checkpoint reload is draining/swapping."""
+        if not self.engine.has_params:
+            return False
+        if self.watcher is not None and self.watcher.reloading:
+            return False
+        return True
+
+    def _effective_checkpoint_step(self) -> Optional[int]:
+        """The checkpoint step reported to routers. The stale-checkpoint
+        fault overrides it so staleness handling is testable without
+        producing real stale checkpoints."""
+        injector = self.fault_injector
+        override = getattr(injector, "stale_checkpoint_step", None) if injector else None
+        if override is not None:
+            return int(override)
+        return self.watcher.loaded_step if self.watcher else None
 
     # ------------------------------------------------------------------
 
@@ -178,8 +223,12 @@ class InferenceServer:
         out = {
             "id": req.id,
             "token_ids": req.token_ids,
+            "token_logprobs": req.token_logprobs,
             "finish_reason": req.finish_reason,
             "latency_s": req.latency_s,
+            # which weights produced this rollout — routers enforce the
+            # staleness bound per-reply, not just per-probe
+            "checkpoint_step": self._effective_checkpoint_step(),
         }
         if self.tokenizer is not None:
             out["text"] = self.tokenizer.decode(req.token_ids)
@@ -207,6 +256,7 @@ class InferenceServer:
                     self.send_error(404)
                     return
                 injector = server.fault_injector
+                slow_through = False
                 if injector is not None and injector.should_fail():
                     mode = injector.mode
                     if mode == "mixed":
@@ -218,8 +268,25 @@ class InferenceServer:
                         except OSError:
                             pass
                         return
-                    self._reply_json(503, {"error": "injected transient failure"})
-                    return
+                    if mode == "hang":
+                        # unresponsive replica: hold the socket without
+                        # answering, then drop it — clients only escape
+                        # via their own timeout / hedge
+                        time.sleep(injector.hang_s)
+                        self.close_connection = True
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
+                    if mode == "slow":
+                        # slow decode: delayed but CORRECT answer —
+                        # exercises hedging, not failover
+                        time.sleep(injector.slow_s)
+                        slow_through = True
+                    if not slow_through:
+                        self._reply_json(503, {"error": "injected transient failure"})
+                        return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
@@ -254,13 +321,20 @@ class InferenceServer:
                     return
                 if path in ("", "/healthz"):
                     watcher = server.watcher
+                    ready = server.ready
                     self._reply_json(200, {
-                        "status": "ok",
+                        # liveness ("process is up") vs readiness ("can
+                        # take traffic now") — a reload in flight is live
+                        # but not ready; status keeps its legacy meaning
+                        "status": "ok" if ready else "degraded",
+                        "live": True,
+                        "ready": ready,
+                        "reloading": bool(watcher.reloading) if watcher else False,
                         "slots_total": server.engine.num_slots,
                         "slots_active": server.engine.active_slots,
                         "queue_depth": int(server.metrics.get("queue_depth")),
                         "param_version": server.engine.param_version,
-                        "checkpoint_step": watcher.loaded_step if watcher else None,
+                        "checkpoint_step": server._effective_checkpoint_step(),
                         "reloads": watcher.reloads if watcher else 0,
                     })
                     return

@@ -33,6 +33,7 @@ import random
 import shutil
 import signal
 import tempfile
+import threading
 import time
 from contextlib import contextmanager
 from typing import Callable, Iterable, List, Optional, Tuple, Type
@@ -362,6 +363,12 @@ def retry(
                     ):
                         raise
                     delay = compute_backoff(attempt, base_delay, max_delay, jitter, rng)
+                    # the dependency's own backoff hint (e.g. Retry-After
+                    # computed from queue depth) overrides a shorter local
+                    # schedule, still capped at max_delay
+                    hint = getattr(e, "retry_after", None)
+                    if hint is not None:
+                        delay = min(max(delay, float(hint)), max_delay)
                     if max_elapsed is not None:
                         delay = min(delay, max(0.0, max_elapsed - elapsed))
                     if on_retry is not None:
@@ -389,6 +396,10 @@ class CircuitBreaker:
     breaker opens and `check()` raises `CircuitOpenError` without touching
     the dependency. After `recovery_time` seconds the breaker half-opens:
     one probe call is allowed; success closes it, failure re-opens it.
+
+    Thread-safe: half-open admits exactly one probe even under concurrent
+    `check()` callers (the fleet router shares one breaker per replica
+    across its request pool).
     """
 
     def __init__(
@@ -403,6 +414,7 @@ class CircuitBreaker:
         self.failures = 0
         self.opened_at: Optional[float] = None
         self._half_open = False
+        self._lock = threading.Lock()
 
     @property
     def state(self) -> str:
@@ -414,33 +426,36 @@ class CircuitBreaker:
 
     def check(self) -> None:
         """Raise CircuitOpenError if calls must fail fast."""
-        state = self.state
-        if state == "closed":
-            return
-        if state == "half-open" and not self._half_open:
-            self._half_open = True  # admit exactly one probe
-            return
-        raise CircuitOpenError(
-            f"circuit open after {self.failures} consecutive failures; "
-            f"retrying dependency in "
-            f"{max(0.0, self.recovery_time - (self._clock() - self.opened_at)):.1f}s"
-        )
+        with self._lock:
+            state = self.state
+            if state == "closed":
+                return
+            if state == "half-open" and not self._half_open:
+                self._half_open = True  # admit exactly one probe
+                return
+            raise CircuitOpenError(
+                f"circuit open after {self.failures} consecutive failures; "
+                f"retrying dependency in "
+                f"{max(0.0, self.recovery_time - (self._clock() - self.opened_at)):.1f}s"
+            )
 
     def record_success(self) -> None:
-        self.failures = 0
-        self.opened_at = None
-        self._half_open = False
+        with self._lock:
+            self.failures = 0
+            self.opened_at = None
+            self._half_open = False
 
     def record_failure(self) -> None:
-        self.failures += 1
-        self._half_open = False
-        if self.failures >= self.failure_threshold:
-            if self.opened_at is None:
-                logger.warning(
-                    f"Circuit breaker OPEN after {self.failures} consecutive "
-                    "failures"
-                )
-            self.opened_at = self._clock()
+        with self._lock:
+            self.failures += 1
+            self._half_open = False
+            if self.failures >= self.failure_threshold:
+                if self.opened_at is None:
+                    logger.warning(
+                        f"Circuit breaker OPEN after {self.failures} consecutive "
+                        "failures"
+                    )
+                self.opened_at = self._clock()
 
 
 # ----------------------------------------------------------------------
@@ -454,7 +469,15 @@ class FaultInjector:
     Either an explicit `schedule` (list of truthy = inject) consumed
     round-robin, or a seeded Bernoulli `rate`. `mode` picks the injected
     failure for HTTP servers: "http_500" answers 500, "drop" closes the
-    connection without a response (a connection reset at the client).
+    connection without a response (a connection reset at the client),
+    "hang" holds the socket for `hang_s` then drops it (client escapes
+    only via its own timeout/hedge), "slow" delays the CORRECT answer by
+    `slow_s` (exercises hedging, not failover).
+
+    Replica-level faults for fleet tests: `stale_checkpoint_step`
+    overrides the checkpoint step a server reports (simulating a replica
+    stuck behind the weight sync) without producing real checkpoints, and
+    `kill_replica` takes a whole in-process server down mid-rollout.
     """
 
     def __init__(
@@ -464,11 +487,17 @@ class FaultInjector:
         schedule: Optional[List[bool]] = None,
         mode: str = "http_500",
         cycle: bool = False,
+        hang_s: float = 30.0,
+        slow_s: float = 0.25,
+        stale_checkpoint_step: Optional[int] = None,
     ):
         self.rate = rate
         self.mode = mode
         self.schedule = list(schedule) if schedule is not None else None
         self.cycle = cycle
+        self.hang_s = float(hang_s)
+        self.slow_s = float(slow_s)
+        self.stale_checkpoint_step = stale_checkpoint_step
         self._rng = random.Random(seed)
         self._calls = 0
         self.injected = 0
@@ -487,6 +516,15 @@ class FaultInjector:
         if fail:
             self.injected += 1
         return fail
+
+    # -- replica death ----------------------------------------------------
+
+    @staticmethod
+    def kill_replica(server) -> None:
+        """Take an in-process `InferenceServer` down as a preemption
+        would: the HTTP listener closes (new connections are refused) and
+        in-flight requests finish as "shutdown"."""
+        server.shutdown()
 
     # -- checkpoint corruption --------------------------------------------
 

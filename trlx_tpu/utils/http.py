@@ -98,9 +98,17 @@ class RetryingJSONClient:
                 except Exception:
                     detail = str(e)
                 if "injected transient" in str(detail) or e.code in TRANSIENT_HTTP_CODES:
-                    raise resilience.TransientError(
-                        f"{label} {e.code}: {detail}"
-                    ) from e
+                    err = resilience.TransientError(f"{label} {e.code}: {detail}")
+                    # a 503's Retry-After is the server's own backoff hint
+                    # (computed from queue depth) — `resilience.retry`
+                    # prefers it over the local schedule when present
+                    hint = e.headers.get("Retry-After") if e.headers else None
+                    if hint is not None:
+                        try:
+                            err.retry_after = float(hint)
+                        except ValueError:
+                            pass  # HTTP-date form: fall back to local backoff
+                    raise err from e
                 raise RuntimeError(f"{label} error: {detail}") from e
             raise RuntimeError(f"{label} error: {e}") from e
         except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:

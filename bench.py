@@ -144,8 +144,35 @@ def trunk_cache_requested(argv) -> bool:
     )
 
 
+def spec_decode_requested(argv) -> bool:
+    """Self-speculative decode (frozen-trunk draft + one suffix verify
+    pass per round) is ON by default in the bench harness — the library
+    default stays off, but the headline measurement exercises the
+    speculative sampler, and the plain-decode number is still reported
+    every run via the same-process `generate_plain` phase. Opt out with
+    `--no-spec-decode` (or `method.speculative_decode=false`)."""
+    return not any(
+        a.replace(" ", "") in ("method.speculative_decode=false",
+                               "--no-spec-decode")
+        for a in argv
+    )
+
+
+def int8_requested(argv) -> bool:
+    """Int8 weight-only decode for the frozen trunk is ON by default in
+    the bench harness (same convention as the trunk cache: library
+    default off, headline on). Opt out with `--no-int8` (or
+    `method.quantize_frozen_trunk=false`)."""
+    return not any(
+        a.replace(" ", "") in ("method.quantize_frozen_trunk=false",
+                               "--no-int8")
+        for a in argv
+    )
+
+
 def build_trainer(smoke: bool = False, fast: bool = False,
-                  trunk_cache: bool = False):
+                  trunk_cache: bool = False, spec_decode: bool = False,
+                  int8: bool = False):
     from trlx_tpu.data.default_configs import default_ppo_config
     from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
     from trlx_tpu.trainer.ppo_trainer import PPOTrainer
@@ -155,6 +182,10 @@ def build_trainer(smoke: bool = False, fast: bool = False,
         config = config.evolve(method=dict(capture_rollout_stats=True))
     if trunk_cache:
         config = config.evolve(method=dict(cache_trunk_activations=True))
+    if spec_decode:
+        config = config.evolve(method=dict(speculative_decode=True))
+    if int8:
+        config = config.evolve(method=dict(quantize_frozen_trunk=True))
     if smoke:
         # num_layers_unfrozen 1 (not the default 2): gpt2-tiny has two
         # blocks, and a 2-of-2 split leaves no frozen suffix — which
@@ -245,7 +276,9 @@ def run_cycle(trainer, config):
 def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
                     unfrozen, window_ok: bool = True,
                     fast_path: bool = False,
-                    trunk_cache: bool = False) -> dict:
+                    trunk_cache: bool = False,
+                    spec_k: int = 0, spec_accept: float = 0.0,
+                    spec_rank: int = 64) -> dict:
     """Itemized FLOP estimate for one PPO cycle (documented approximations;
     used only for the MFU estimate, never for vs_baseline).
 
@@ -271,7 +304,29 @@ def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
                          + (head if with_head else 0))
 
     # generation: prefill the prompt, then n_new cached decode steps
-    gen = fwd(n_prompt, n_prompt / 2) + fwd(n_new, n_prompt + n_new / 2)
+    if spec_k > 0:
+        # HONEST speculative accounting: charge what the chip actually
+        # computes, including rejected-draft waste. Each round runs k+1
+        # per-row t=1 TRUNK steps (pending + k drafts), k low-rank draft
+        # readouts, and ONE batched suffix verify over k+1 positions (the
+        # suffix blocks plus the full lm_head at each verified position).
+        # Rounds needed = n_new / E[tokens emitted per round], with
+        # E[tokens/round] = 1 + accept_rate * k from the MEASURED accept
+        # rate — a wrong draft head inflates rounds and deflates MFU
+        # instead of silently flattering the denominator.
+        ctx = n_prompt + n_new / 2
+        split_L = max(L - unfrozen, 1)
+        trunk_step = split_L * blk + split_L * 4 * ctx * d
+        suffix_pos = unfrozen * blk + unfrozen * 4 * ctx * d + head
+        draft_head = 2 * d * spec_rank + 2 * spec_rank * V
+        per_round = ((spec_k + 1) * trunk_step + spec_k * draft_head
+                     + (spec_k + 1) * suffix_pos)
+        tokens_per_round = 1.0 + max(0.0, min(1.0, spec_accept)) * spec_k
+        rounds = max(n_new - 1, 0) / tokens_per_round  # token 0 is plain
+        gen = (fwd(n_prompt, n_prompt / 2)  # prefill (emits token 0)
+               + rounds * per_round)
+    else:
+        gen = fwd(n_prompt, n_prompt / 2) + fwd(n_new, n_prompt + n_new / 2)
     if fast_path:
         # fast rollout path: policy logprobs + values were captured inside
         # the sampling loop (already counted under gen), so score is ONLY
@@ -394,6 +449,21 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
         lambda r: r[1]["samples"][0, 0],
     )
     times["generate"] = max(t - rtt, 1e-9)
+
+    if trainer._spec_k_effective() > 0:
+        # same-process spec-vs-plain A/B: re-time generation with the
+        # speculative sampler forced off (same prompts distribution, same
+        # params, same process) so the headline speedup is attributable
+        orig_eff = trainer._spec_k_effective
+        trainer._spec_k_effective = lambda: 0
+        try:
+            t, _ = timed(
+                lambda: trainer.dispatch_rollout_generation(),
+                lambda r: r[1]["samples"][0, 0],
+            )
+            times["generate_plain"] = max(t - rtt, 1e-9)
+        finally:
+            trainer._spec_k_effective = orig_eff
 
     spec = None
     if fast:
@@ -611,7 +681,10 @@ def main():
     classic = "--classic" in sys.argv
     fast = fast_rollout_requested(sys.argv[1:])
     trunk_cache = trunk_cache_requested(sys.argv[1:])
-    trainer, config = build_trainer(smoke, fast=fast, trunk_cache=trunk_cache)
+    spec_decode = spec_decode_requested(sys.argv[1:])
+    int8 = int8_requested(sys.argv[1:])
+    trainer, config = build_trainer(smoke, fast=fast, trunk_cache=trunk_cache,
+                                    spec_decode=spec_decode, int8=int8)
     n_chips = max(jax.device_count(), 1)
 
     # >=100 cycles / >=45s: r3's 21-cycle/10.6s window was small enough
@@ -654,6 +727,19 @@ def main():
     sps_chip = samples / elapsed / n_chips
     tps_chip = tokens / elapsed / n_chips
 
+    # measured speculative acceptance over the whole timed window — feeds
+    # the HONEST FLOP denominator below (rejected drafts are charged)
+    spec_k_eff = trainer._spec_k_effective()
+    spec_rounds = int(getattr(trainer, "spec_decode_rounds", 0))
+    spec_accepted = int(getattr(trainer, "spec_decode_accepted", 0))
+    accept_rate = (spec_accepted / (spec_k_eff * spec_rounds)
+                   if spec_rounds and spec_k_eff else 0.0)
+    if spec_decode and getattr(trainer, "spec_decode_fallbacks", 0):
+        sys.stderr.write(
+            f"[bench] speculative decode fell back "
+            f"{trainer.spec_decode_fallbacks}x to the plain sampler\n"
+        )
+
     window_ok = (trainer._window_loss_ok()
                  and getattr(trainer.model_cfg, "moe_experts", 0) == 0)
     flops = flops_per_cycle(
@@ -662,6 +748,8 @@ def main():
         window_ok=window_ok,
         fast_path=(not classic) and trainer._fast_rollout_available(),
         trunk_cache=trainer._trunk_cache_available(),
+        spec_k=spec_k_eff, spec_accept=accept_rate,
+        spec_rank=int(getattr(config.method, "spec_draft_rank", 64)),
     )
     mfu = flops["total"] * cycles / elapsed / n_chips / chip_peak_flops()
 
@@ -688,13 +776,22 @@ def main():
                 + " | ".join(
                     f"{k} {times[k]*1e3:.0f}ms"
                     + (f" (MFU {phase_mfu[k]:.3f})" if k in phase_mfu else "")
-                    for k in ("generate", "score", "host_fetch_process",
+                    for k in ("generate", "generate_plain", "score",
+                              "host_fetch_process",
                               "cache_trunk", "train", "train_full")
                     if k in times
                 )
                 + f" | rtt {rtt*1e3:.0f}ms | cycle wall {cycle_wall*1e3:.0f}ms"
                 f" | overlap {phase_json['overlap_efficiency']:.2f}\n"
             )
+            if "generate_plain" in times:
+                sys.stderr.write(
+                    f"[bench] spec-decode generate A/B (same process, same "
+                    f"params): spec {times['generate']*1e3:.0f}ms vs plain "
+                    f"{times['generate_plain']*1e3:.0f}ms "
+                    f"({times['generate_plain'] / times['generate']:.2f}x), "
+                    f"accept rate {accept_rate:.2f} at k={spec_k_eff}\n"
+                )
             if "train_full" in times:
                 sys.stderr.write(
                     f"[bench] trunk-cache train A/B (same process, same "
@@ -705,6 +802,14 @@ def main():
                 )
         except Exception as e:  # the headline must survive instrumentation
             sys.stderr.write(f"[bench] phase instrumentation failed: {e}\n")
+
+    if spec_k_eff > 0:
+        phase_json["spec_k"] = spec_k_eff
+        phase_json["spec_accept_rate"] = round(accept_rate, 3)
+        phase_json["spec_tokens_per_round"] = round(
+            1.0 + accept_rate * spec_k_eff, 3)
+    phase_json["decode_weights"] = (
+        "int8_frozen_trunk" if int8 and trainer.split > 0 else "dense")
 
     baseline = ESTIMATED_A100_SAMPLES_PER_SEC * NORTH_STAR_MULTIPLE
     print(json.dumps({

@@ -1,0 +1,45 @@
+"""CI smoke: a 2-cycle PPO loop with speculative decode + int8 frozen-trunk
+decode ON (tiny random model, CPU). Passes when the loop completes with a
+finite loss, ZERO speculative-decode fallbacks (the gate must accept the
+smoke configuration — a silent fallback would make the CI step vacuous),
+and at least one speculative round actually executed.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/spec_decode_smoke.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from bench import build_trainer  # noqa: E402
+
+
+def main():
+    trainer, config = build_trainer(smoke=True, spec_decode=True, int8=True)
+    _, pending = trainer.pipelined_cycle()
+    _, pending = trainer.pipelined_cycle(pending)
+    loss = float(np.asarray(pending[2][0]))
+
+    rounds = int(getattr(trainer, "spec_decode_rounds", 0))
+    accepted = int(getattr(trainer, "spec_decode_accepted", 0))
+    fallbacks = int(getattr(trainer, "spec_decode_fallbacks", 0))
+    k = int(config.method.spec_k)
+
+    assert np.isfinite(loss), f"non-finite loss after 2 spec-decode cycles: {loss}"
+    assert fallbacks == 0, (
+        f"speculative decode fell back {fallbacks}x — the smoke config must "
+        "pass the gate, otherwise this step tests nothing"
+    )
+    assert rounds > 0, "no speculative rounds ran"
+    print(
+        f"spec-decode smoke OK: loss {loss:.4f}, {rounds} rounds, "
+        f"accept rate {accepted / (k * rounds):.2f} at k={k}, 0 fallbacks"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -41,11 +41,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu.models.transformer import init_kv_cache
+from trlx_tpu.ops.quant import dequantize_tree
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
     process_logits,
     sampled_token_logprob,
     select_token,
+    spec_draft_head_from_params,
 )
 from trlx_tpu.utils import logging
 
@@ -86,11 +88,24 @@ class InferenceEngine:
         max_prefill_batch: int = 8,
         prompt_bucket: int = 32,
         seed: int = 0,
+        spec_k: int = 0,
+        spec_split: int = 0,
+        spec_draft_rank: int = 64,
     ):
         if getattr(model_cfg, "is_seq2seq", False):
             raise NotImplementedError(
                 "the continuous-batching engine serves causal LMs only"
             )
+        if spec_k > 0:
+            if spec_split <= 0:
+                raise ValueError(
+                    "speculative decode needs a hydra split > 0 (the frozen "
+                    "trunk is the draft model)"
+                )
+            if getattr(model_cfg, "moe_experts", 0) > 0:
+                raise NotImplementedError(
+                    "speculative decode under MoE routing is unsupported"
+                )
         if getattr(model_cfg, "prompt_tokens", 0) > 0 or getattr(model_cfg, "prefix_tokens", 0) > 0:
             raise NotImplementedError(
                 "slot-pool decode under prompt/prefix tuning is unsupported"
@@ -110,10 +125,19 @@ class InferenceEngine:
         self.max_prompt_len = _round_up(int(max_prompt_len), self.prompt_bucket)
         self.max_prefill_batch = int(max_prefill_batch)
         self.max_len = self.max_prompt_len + gen_cfg.max_new_tokens
+        self.spec_k = int(spec_k)
+        self.spec_split = int(spec_split)
+        self.spec_draft_rank = int(spec_draft_rank)
+        # a speculative round may write spec_k cache rows past a slot's
+        # budget before the rollback clears them — give the pool the slack
+        self._cache_len = self.max_len + self.spec_k
 
         self._params = params
         self._param_lock = threading.Lock()
         self._param_version = 0
+        self._spec_head = None
+        if self.spec_k > 0 and params is not None:
+            self._spec_head = self._build_spec_head(params)
 
         V = model_cfg.vocab_size
         P = self.num_slots
@@ -123,7 +147,7 @@ class InferenceEngine:
             m[np.asarray(gen_cfg.suppress_tokens, np.int64)] = -np.inf
             self._suppress = jnp.asarray(m)
 
-        cache = init_kv_cache(model_cfg, P, self.max_len)
+        cache = init_kv_cache(model_cfg, P, self._cache_len)
         # Fused sampling: the pool carries each slot's PRE-SAMPLED next
         # token + its policy logprob instead of a [P, V] f32 logits bank —
         # suppress/warping/categorical draw happen inside the same jitted
@@ -144,7 +168,7 @@ class InferenceEngine:
         }
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._insert_fns: Dict[int, Callable] = {}
-        self._decode_fn = self._make_decode()
+        self._decode_fn = self._make_spec_decode() if self.spec_k > 0 else self._make_decode()
 
     # ------------------------------------------------------------------
     # Params (checkpoint hot-reload)
@@ -154,11 +178,24 @@ class InferenceEngine:
         """Atomically swap the served params. In-flight requests continue
         on the new weights from their next decode step — the KV cache
         keeps the old prefix's keys/values, exactly like serving a live
-        policy mid-update. Returns the new param version."""
+        policy mid-update. Under speculative decode the low-rank draft
+        head is recomputed from the fresh unembedding (host-side SVD) so
+        draft quality tracks the served policy; the swap of (params,
+        head) is atomic under the same lock. Returns the new param
+        version."""
+        head = self._build_spec_head(params) if self.spec_k > 0 else None
         with self._param_lock:
             self._params = params
+            self._spec_head = head
             self._param_version += 1
             return self._param_version
+
+    def _build_spec_head(self, params):
+        a, b = spec_draft_head_from_params(
+            params, self.model_cfg, self.spec_draft_rank
+        )
+        dtype = getattr(self.model_cfg, "dtype", jnp.float32)
+        return jnp.asarray(a, dtype), jnp.asarray(b, dtype)
 
     @property
     def param_version(self) -> int:
@@ -174,6 +211,10 @@ class InferenceEngine:
     def _current_params(self):
         with self._param_lock:
             return self._params
+
+    def _current_params_and_head(self):
+        with self._param_lock:
+            return self._params, self._spec_head
 
     # ------------------------------------------------------------------
     # Fused sampling (traced inside the insert / decode programs)
@@ -200,9 +241,12 @@ class InferenceEngine:
     def _get_prefill(self, pb: int, plen: int) -> Callable:
         key = (pb, plen)
         if key not in self._prefill_fns:
-            model, cfg, S = self.model, self.model_cfg, self.max_len
+            model, cfg, S = self.model, self.model_cfg, self._cache_len
 
             def prefill(params, ids, mask):
+                # no-op for dense trees; reconstructs the int8 frozen-trunk
+                # view in-graph (ops/quant.py)
+                params = dequantize_tree(params)
                 cache = init_kv_cache(cfg, ids.shape[0], S)
                 out = model.apply(
                     {"params": params}, ids, cache, mask, True,
@@ -318,6 +362,7 @@ class InferenceEngine:
         sample_fused = self._sample_fused
 
         def decode(params, pool):
+            params = dequantize_tree(params)
             active = pool["active"].astype(bool)
             # emit the token the PREVIOUS program (insert or decode)
             # already sampled — no warping work on this side of the model
@@ -354,14 +399,183 @@ class InferenceEngine:
 
         return jax.jit(decode, donate_argnums=(1,))
 
+    def _make_spec_decode(self) -> Callable:
+        """Speculative slot decode: one call emits the slot's pending
+        token plus every draft the full model accepts (up to spec_k+1
+        tokens per slot per call). The frozen trunk runs spec_k+1 per-row
+        cached steps (draft tokens from the low-rank readout between
+        them), ONE batched suffix pass verifies all positions from the
+        trunk's own h_split, and the longest matching prefix is accepted
+        with exact rejection-sampling correction — the correction token
+        becomes the slot's new pending `next_token`, preserving the plain
+        path's sampled-but-unemitted invariant. Greedy emissions are
+        bitwise the plain decode program's; rejected KV rows are rolled
+        back by clearing mask bits."""
+        model, gen_cfg = self.model, self.gen_cfg
+        pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
+        k, split = self.spec_k, self.spec_split
+        greedy = (not gen_cfg.do_sample) or (gen_cfg.temperature == 0.0)
+        suppress = self._suppress
+
+        def warp(raw_logits, step):
+            scores = raw_logits
+            if suppress is not None:
+                scores = scores + suppress
+            return process_logits(scores, gen_cfg, step)
+
+        def decode(params, pool, a_fac, b_fac):
+            params = dequantize_tree(params)
+            P = pool["active"].shape[0]
+            active = pool["active"].astype(bool)
+            act_i = active.astype(jnp.int32)
+            step0 = pool["step"]
+            rng = pool["rng"]
+            cache = {key: pool[key] for key in ("layers", "mask", "pos", "row_index")}
+            row_start = pool["row_index"]
+            pos_start = pool["pos"]
+            f0 = jnp.where(active, pool["next_token"], pad)
+            f = f0
+            h_rows, q_scores, draft_toks = [], [], []
+            for j in range(k + 1):
+                h_j, hn_j, cache = model.apply(
+                    {"params": params}, f[:, None], cache, act_i[:, None],
+                    split, method=type(model).spec_draft_step,
+                )
+                h_rows.append(h_j)
+                if j < k:
+                    rng, key = jax.random.split(rng)
+                    dl = ((hn_j[:, 0] @ a_fac) @ b_fac).astype(jnp.float32)
+                    sq = warp(dl, step0 + 1 + j)
+                    f = select_token(sq, key, gen_cfg).astype(jnp.int32)
+                    q_scores.append(sq)
+                    draft_toks.append(f)
+            h_block = jnp.concatenate(h_rows, axis=1)
+            positions = pos_start[:, None] + jnp.arange(k + 1)[None, :]
+            out = model.apply(
+                {"params": params}, h_block, cache, row_start, positions,
+                split, method=type(model).spec_verify_rows,
+            )
+            logits_v, new_layers = out[0].astype(jnp.float32), out[2]
+            cache = dict(cache, layers=new_layers)
+            p_scores = [warp(logits_v[:, j], step0 + 1 + j) for j in range(k + 1)]
+            if greedy:
+                acc = [
+                    jnp.argmax(p_scores[j], -1).astype(jnp.int32) == draft_toks[j]
+                    for j in range(k)
+                ]
+            else:
+                acc = []
+                for j in range(k):
+                    rng, key = jax.random.split(rng)
+                    u = jax.random.uniform(key, (P,))
+                    tok = draft_toks[j][:, None]
+                    lr = (
+                        jnp.take_along_axis(jax.nn.log_softmax(p_scores[j], -1), tok, 1)
+                        - jnp.take_along_axis(jax.nn.log_softmax(q_scores[j], -1), tok, 1)
+                    )[:, 0]
+                    acc.append(u < jnp.exp(jnp.minimum(lr, 0.0)))
+            run = jnp.ones((P,), bool)
+            m = jnp.zeros((P,), jnp.int32)
+            for j in range(k):
+                run = run & acc[j]
+                m = m + run.astype(jnp.int32)
+            corr, corr_lp = [], []
+            lsm_v = jax.nn.log_softmax(logits_v, axis=-1)
+            for j in range(k + 1):
+                if greedy:
+                    c = jnp.argmax(p_scores[j], -1).astype(jnp.int32)
+                elif j < k:
+                    rng, key = jax.random.split(rng)
+                    p_w = jax.nn.softmax(p_scores[j], -1)
+                    q_w = jax.nn.softmax(q_scores[j], -1)
+                    res = jnp.clip(p_w - q_w, 0.0, None)
+                    tot = res.sum(-1, keepdims=True)
+                    res = jnp.where(tot > 0, res / tot, p_w)
+                    c = jax.random.categorical(
+                        key, jnp.where(res > 0, jnp.log(res), -jnp.inf), axis=-1
+                    ).astype(jnp.int32)
+                else:
+                    rng, key = jax.random.split(rng)
+                    c = select_token(p_scores[j], key, gen_cfg).astype(jnp.int32)
+                corr.append(c)
+                corr_lp.append(
+                    jnp.take_along_axis(lsm_v[:, j], c[:, None], axis=-1)[:, 0]
+                )
+            corr = jnp.stack(corr, axis=1)
+            corr_lp = jnp.stack(corr_lp, axis=1)
+            corr_at_m = jnp.take_along_axis(corr, m[:, None], axis=1)[:, 0]
+            corr_lp_at_m = jnp.take_along_axis(corr_lp, m[:, None], axis=1)[:, 0]
+            # emissions this call: [f0, accepted drafts]; the correction
+            # stays pending as the slot's new next_token
+            jidx = jnp.arange(k + 1)[None, :]
+            draft_mat = (
+                jnp.stack(draft_toks, axis=1)
+                if k > 0 else jnp.zeros((P, 0), jnp.int32)
+            )
+            emit_mat = jnp.concatenate([f0[:, None], draft_mat], axis=1)
+            draft_lp = jnp.stack(
+                [
+                    jnp.take_along_axis(
+                        lsm_v[:, j], draft_toks[j][:, None], axis=-1
+                    )[:, 0]
+                    for j in range(k)
+                ],
+                axis=1,
+            ) if k > 0 else jnp.zeros((P, 0), jnp.float32)
+            lp_mat = jnp.concatenate([pool["next_logprob"][:, None], draft_lp], axis=1)
+            alive = active
+            valids = []
+            for j in range(k + 1):
+                v_j = alive & (j - 1 < m) & (step0 + j < pool["max_new"])
+                valids.append(v_j)
+                alive = v_j & (emit_mat[:, j] != eos)
+            valid_mat = jnp.stack(valids, axis=1)
+            emit_mat = jnp.where(valid_mat, emit_mat, pad)
+            e = valid_mat.astype(jnp.int32).sum(1)
+            hit_eos = jnp.any(valid_mat & (emit_mat == eos), axis=1)
+            new_step = step0 + e
+            finished = active & (hit_eos | (new_step >= pool["max_new"]))
+            # roll back rejected KV rows; keep offsets for the e emitted
+            # (and fed) tokens f_0..f_{e-1}
+            rows_p = jnp.arange(P)[:, None]
+            offs = row_start[:, None] + jidx
+            new_mask = cache["mask"].at[rows_p, offs].set(
+                (jidx < e[:, None]).astype(cache["mask"].dtype)
+            )
+            new_pool = {
+                **pool,
+                "layers": cache["layers"],
+                "mask": new_mask,
+                "pos": pos_start + e,
+                "row_index": row_start + e,
+                "next_token": corr_at_m,
+                "next_logprob": corr_lp_at_m,
+                "step": new_step,
+                "active": pool["active"] * (1 - finished.astype(jnp.int32)),
+                "rng": rng,
+            }
+            return new_pool, emit_mat, lp_mat, valid_mat, finished
+
+        return jax.jit(decode, donate_argnums=(1,))
+
     def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Advance every active slot one token. Returns host arrays
+        """Advance every active slot. Plain mode returns host arrays
         (tokens [P], logprobs [P] f32, emitted [P] bool, finished [P]
-        bool); finished slots are already deactivated in the pool. The
-        logprob is the policy's raw-logit log-probability of the emitted
-        token (see `_sample_fused`), meaningful only where `emitted`."""
-        params = self._current_params()
-        self._pool, token, logprob, valid, finished = self._decode_fn(params, self._pool)
+        bool); speculative mode returns (tokens [P, spec_k+1], logprobs
+        [P, spec_k+1], emitted [P, spec_k+1], finished [P]) — each slot
+        emits between 1 and spec_k+1 tokens per call, in order, flagged
+        by the emitted mask. Finished slots are already deactivated in
+        the pool. The logprob is the policy's raw-logit log-probability
+        of the emitted token (see `_sample_fused`), meaningful only where
+        `emitted`."""
+        if self.spec_k > 0:
+            params, head = self._current_params_and_head()
+            self._pool, token, logprob, valid, finished = self._decode_fn(
+                params, self._pool, head[0], head[1]
+            )
+        else:
+            params = self._current_params()
+            self._pool, token, logprob, valid, finished = self._decode_fn(params, self._pool)
         token, logprob, valid, finished = jax.device_get((token, logprob, valid, finished))
         return (
             np.asarray(token),

@@ -282,16 +282,33 @@ class Scheduler:
         tokens, logprobs, valid, finished = self.engine.step()
         dt = time.perf_counter() - t0
         self.metrics.observe("decode_step_latency_seconds", dt)
+        # normalize the plain program's [P] outputs to the speculative
+        # program's [P, K] layout — one loop body serves both; plain mode
+        # is just K == 1
+        if tokens.ndim == 1:
+            tokens = tokens[:, None]
+            logprobs = logprobs[:, None]
+            valid = valid[:, None]
+        spec = getattr(self.engine, "spec_k", 0) > 0
         emitted = 0
         now = time.monotonic()
         eos = self.engine.gen_cfg.eos_token_id
         for slot, req in list(self._slot_req.items()):
-            if valid[slot]:
-                req.token_ids.append(int(tokens[slot]))
-                req.token_logprobs.append(float(logprobs[slot]))
-                emitted += 1
+            n_slot = 0
+            for j in range(tokens.shape[1]):
+                if valid[slot, j]:
+                    req.token_ids.append(int(tokens[slot, j]))
+                    req.token_logprobs.append(float(logprobs[slot, j]))
+                    n_slot += 1
+            emitted += n_slot
+            if spec and n_slot:
+                # accept-length per slot per speculative round (1 pending
+                # + accepted drafts) — the serving-side mirror of the
+                # trainer's rollout/spec_accept_rate
+                self.metrics.observe("spec_accepted_tokens", n_slot)
             if finished[slot]:
-                reason = "eos" if int(tokens[slot]) == eos else "length"
+                last = req.token_ids[-1] if req.token_ids else -1
+                reason = "eos" if last == eos else "length"
                 self._release(slot)
                 self._finish_request(req, reason)
             elif req.deadline and now > req.deadline:

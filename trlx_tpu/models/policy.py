@@ -211,6 +211,30 @@ class CausalLMWithValueHead(nn.Module):
         trlx_tpu/inference/engine.py). Returns (logits, new_cache)."""
         return self.lm.decode_step_rows(tokens, cache, token_mask)
 
+    def spec_draft_step(self, tokens, cache, token_mask, split: int):
+        """Trunk-only per-row draft step (self-speculative decode). Returns
+        (h_split, h_norm, new_cache) — no heads run during drafting."""
+        return self.lm.spec_draft_step(tokens, cache, token_mask, split)
+
+    def spec_verify_rows(self, h, cache, row_start, positions, split: int,
+                         with_value: bool = False):
+        """Batched suffix verify from the trunk's own h_split rows. Returns
+        (logits, values | None, new_layers); values come from the MLP head
+        on h_final (the deeper value branch is computed in the scoring
+        pass, same restriction as decode_step's per-step values)."""
+        logits, h_final, new_layers = self.lm.spec_verify_rows(
+            h, cache, row_start, positions, split
+        )
+        values = None
+        if with_value:
+            if self.num_value_layers > 0:
+                raise NotImplementedError(
+                    "per-step values during decode are not supported with a "
+                    "value branch (values are computed in the scoring pass)"
+                )
+            values = self.v_head(h_final)[..., 0]
+        return logits, values, new_layers
+
 
 class CausalLMWithILQLHeads(nn.Module):
     cfg: TransformerConfig

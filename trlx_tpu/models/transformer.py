@@ -946,6 +946,94 @@ class TransformerLM(nn.Module):
         }
         return logits, new_cache
 
+    def spec_draft_step(
+        self,
+        tokens: jnp.ndarray,  # [b, 1]
+        cache: Dict[str, Any],
+        token_mask: jnp.ndarray,  # [b, 1] validity (0 = finished/inactive row)
+        split: int,
+    ):
+        """One per-row cached TRUNK step (blocks [0, split) only) for
+        self-speculative drafting: embed + frozen-prefix blocks, no
+        unembedding. Writes trunk K/V at each row's own offset
+        (`cache["row_index"]`) exactly like `decode_step_rows`, leaves the
+        suffix layers' caches untouched (the verify pass writes those), and
+        returns the activation entering block `split` twice: raw (the same
+        h_split `decode_step(capture_split=split)` captures) and through
+        `ln_f` (the early-exit readout the low-rank draft head projects).
+        Mask bits are written incrementally — a drafted position becomes a
+        visible key only once its K/V is in the cache, so later-rejected
+        drafts roll back by clearing bits, and stale K/V beyond the
+        frontier contributes exactly zero (exp(-1e9) == 0.0 in f32)."""
+        if self.cfg.prompt_tokens > 0 or self.cfg.prefix_tokens > 0:
+            raise NotImplementedError(
+                "speculative decode under prompt/prefix tuning is unsupported"
+            )
+        b, _ = tokens.shape
+        row_index = cache["row_index"]
+        positions = cache["pos"][:, None]
+        step_valid = token_mask[:, 0].astype(jnp.int32)
+        new_mask = cache["mask"].at[jnp.arange(b), row_index].set(
+            token_mask[:, 0].astype(cache["mask"].dtype)
+        )
+        bias = decode_bias(new_mask, 1)
+        if self.cfg.alibi:
+            bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
+        if self.cfg.sliding_window is not None:
+            bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
+        h = self.embed(tokens, positions)
+        h, trunk_layers = self.run_blocks(
+            h, bias, positions, 0, split, cache=cache["layers"], cache_index=row_index,
+        )
+        new_cache = {
+            "row_index": row_index + step_valid,
+            "mask": new_mask,
+            "pos": cache["pos"] + step_valid,
+            "layers": trunk_layers + cache["layers"][split:],
+        }
+        return h, self.ln_f(h), new_cache
+
+    def spec_verify_rows(
+        self,
+        h: jnp.ndarray,  # [b, t, d] trunk output at the t drafted positions
+        cache: Dict[str, Any],
+        row_start: jnp.ndarray,  # [b] cache offset of h's first position
+        positions: jnp.ndarray,  # [b, t]
+        split: int,
+    ):
+        """Batched suffix verify for self-speculative decode: resume blocks
+        [split, n_layers) from the trunk's own h_split rows (the
+        forward_from_captures schedule, but against the per-row KV cache),
+        writing suffix K/V for all t candidate positions in ONE pass, so
+        verify pays the suffix blocks only. Assumes mask bits for offsets
+        [row_start, row_start + t) were already set by the preceding
+        `spec_draft_step` calls; within that block, query j may not see
+        keys written for queries > j — the same within-block causal
+        correction `decode_step` applies at prefill, with per-row offsets
+        (doubly-forbidden columns go to -2e9, still exactly 0 after
+        softmax). Returns (logits, h_final, new_layers) where new_layers
+        is the full per-layer cache list (trunk entries passed through)."""
+        b, t, _ = h.shape
+        new_mask = cache["mask"]
+        positions_f = positions.astype(jnp.int32)
+        bias = decode_bias(new_mask, t)
+        if self.cfg.alibi:
+            bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
+        if self.cfg.sliding_window is not None:
+            bias = bias + window_bias(positions_f, new_mask, self.cfg.sliding_window)
+        S = new_mask.shape[-1]
+        q_ids = jnp.arange(t)[None, :, None]
+        k_ids = jnp.arange(S)[None, None, :]
+        start = row_start[:, None, None]
+        within = (k_ids >= start) & (k_ids - start > q_ids)  # [b, t, S]
+        bias = bias + jnp.where(within[:, None], -1e9, 0.0).astype(jnp.float32)
+        h, suffix_layers = self.run_blocks(
+            h, bias, positions_f, split, self.cfg.n_layers,
+            cache=cache["layers"], cache_index=row_start,
+        )
+        logits, h_final = self.unembed(h)
+        return logits, h_final, cache["layers"][:split] + suffix_layers
+
 
 def position_ids(attn_mask: jnp.ndarray) -> jnp.ndarray:
     """Position ids robust to left padding: cumsum of the mask - 1, clipped

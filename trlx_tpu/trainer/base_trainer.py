@@ -265,16 +265,18 @@ class TPUTrainer(BaseRLTrainer):
         return key
 
     def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm",
-                        capture: bool = False):
+                        capture: bool = False, spec_k: int = 0):
         """Jit-cached generate fn per (shape, kwargs) bucket. `capture`
         builds the rollout fast-path sampler, which additionally emits
-        per-token logprobs/values and the hydra-split activations (see
-        ops/sampling.py)."""
+        per-token logprobs/values and the hydra-split activations; spec_k
+        > 0 builds the self-speculative draft/verify sampler instead of
+        the token-at-a-time loop (see ops/sampling.py)."""
         from trlx_tpu.ops.sampling import GenerationConfig, make_generate_fn
 
         # repr-normalize values: gen_kwargs may carry unhashable HF-style
         # knobs (lists/dicts) from configs written against the reference
-        key = (batch_size, prompt_len, repr(sorted(gen_kwargs.items())), mode, bool(capture))
+        key = (batch_size, prompt_len, repr(sorted(gen_kwargs.items())), mode, bool(capture),
+               int(spec_k))
         if key not in self._generate_cache:
             gen_cfg = GenerationConfig.from_gen_kwargs(
                 gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
@@ -284,9 +286,27 @@ class TPUTrainer(BaseRLTrainer):
                 self.model, self.model_cfg, gen_cfg, mode=mode,
                 logit_mask=self.logit_mask, two_qs=two_qs,
                 capture=capture, capture_split=self.split if capture else 0,
+                spec_k=spec_k, spec_split=self.split if spec_k > 0 else 0,
+                spec_draft_head=self._spec_draft_head() if spec_k > 0 else None,
             )
             self._generate_cache[key] = jax.jit(fn)
         return self._generate_cache[key]
+
+    def _spec_draft_head(self):
+        """Low-rank draft readout for speculative decode; trainers that
+        enable method.speculative_decode override this with a cached SVD
+        of the frozen unembedding (ppo_trainer)."""
+        raise NotImplementedError(
+            "speculative decode needs a trainer-provided draft head"
+        )
+
+    def _decode_params(self) -> Dict:
+        """Param view fed to the sampler. The base view is the merged
+        train+frozen tree; trainers that enable
+        method.quantize_frozen_trunk override this with the int8
+        frozen-trunk view (ppo_trainer). Train/score paths never call
+        this."""
+        return self.params
 
     def _bucket_prompts(self, input_ids, attention_mask):
         """Round the generate batch up to a multiple of 8 rows and the
@@ -326,7 +346,7 @@ class TPUTrainer(BaseRLTrainer):
         return trimmed
 
     def generate(self, input_ids, attention_mask, gen_kwargs: Optional[Dict] = None, mode: str = "lm",
-                 capture: bool = False):
+                 capture: bool = False, spec_k: int = 0):
         """Sample continuations for a (host) prompt batch; returns the
         sampling dict (device arrays)."""
         gen_kwargs = gen_kwargs if gen_kwargs is not None else self.generate_kwargs
@@ -341,8 +361,9 @@ class TPUTrainer(BaseRLTrainer):
         else:
             orig = (input_ids.shape[0], 0)
         fn = self.get_generate_fn(input_ids.shape[0], input_ids.shape[1], gen_kwargs, mode,
-                                  capture=capture)
-        out = fn(self.params, jnp.asarray(input_ids), jnp.asarray(attention_mask), self.next_rng())
+                                  capture=capture, spec_k=spec_k)
+        out = fn(self._decode_params(), jnp.asarray(input_ids), jnp.asarray(attention_mask),
+                 self.next_rng())
         return self._unbucket_output(out, orig)
 
     def decode(

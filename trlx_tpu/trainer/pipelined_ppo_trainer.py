@@ -128,6 +128,39 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             )
         return False
 
+    def _spec_decode_available(self) -> bool:
+        """Speculative decode is unavailable here for the same reason as
+        the fast rollout path: the draft/verify split applies
+        (spec_draft_step / spec_verify_rows) need the unstacked per-block
+        layout — the plain sampler stays in charge."""
+        if (
+            getattr(self.config.method, "speculative_decode", False)
+            and not getattr(self, "_warned_no_spec_decode", False)
+        ):
+            self._warned_no_spec_decode = True
+            logger.warning(
+                "method.speculative_decode is ignored under pipeline "
+                "parallelism (stacked params cannot run the draft/verify "
+                "applies); sampling with the plain fused loop"
+            )
+        return False
+
+    def _decode_params(self):
+        """The int8 decode view is unavailable here: quantize_frozen_flat
+        walks the unstacked per-block layout, not the lm_stacked pytree —
+        the dense merged tree stays in charge."""
+        if (
+            getattr(self.config.method, "quantize_frozen_trunk", False)
+            and not getattr(self, "_warned_no_quantize", False)
+        ):
+            self._warned_no_quantize = True
+            logger.warning(
+                "method.quantize_frozen_trunk is ignored under pipeline "
+                "parallelism (the int8 view targets the unstacked block "
+                "layout); sampling with dense weights"
+            )
+        return self.params
+
     # ------------------------------------------------------------------
     # Loss through the GPipe program
     # ------------------------------------------------------------------

@@ -91,6 +91,23 @@ class PPOConfig(MethodConfig):
     # for short responses). Default off to preserve reference-parity
     # curves (the reference whitens unmasked, utils/modeling.py whiten).
     whiten_with_mask: bool = False
+    # Self-speculative decode: the frozen hydra trunk plus a low-rank SVD
+    # readout of the unembedding drafts spec_k tokens per round; one
+    # batched suffix pass verifies all of them from the trunk's own
+    # h_split (forward_from_captures economics applied to sampling) and
+    # accepts the longest matching prefix with exact rejection-sampling
+    # correction — greedy output stays bitwise the plain sampler's,
+    # sampled output follows the identical distribution. Default off:
+    # flag off is bit-identical to the plain fused sampler. Extra fields
+    # vs the reference config set.
+    speculative_decode: bool = False
+    spec_k: int = 4
+    spec_draft_rank: int = 64
+    # Int8 weight-only view of the never-trained decode weights (blocks
+    # below the hydra split + embeddings) swapped in for GENERATION only;
+    # train/score always see the dense tree. Default off: flag off is
+    # bit-identical. Extra field vs the reference config set.
+    quantize_frozen_trunk: bool = False
 
 
 @register_trainer
@@ -546,7 +563,12 @@ class PPOTrainer(TPUTrainer):
             b = next(self.prompt_iterator)
             if use_fleet:
                 return b, self._fleet_generate(b, gen_kwargs, trainer_step=iter_count)
-            return b, self.generate(b["input_ids"], b["attention_mask"], gen_kwargs)
+            # spec_k only travels when a speculative round is actually on:
+            # the parallel mixins' generate() has no spec_k parameter.
+            spec_k = self._spec_k_effective()
+            spec_kw = {"spec_k": spec_k} if spec_k else {}
+            return b, self.generate(b["input_ids"], b["attention_mask"], gen_kwargs,
+                                    **spec_kw)
 
         pending = _dispatch_next()
 
@@ -567,6 +589,7 @@ class PPOTrainer(TPUTrainer):
             real_tokens = int(np.asarray(out["response_mask"]).sum())
             stats["throughput/rollout_tokens_per_s"] = real_tokens / gen_s
             stats["throughput/rollout_requests_per_s"] = n_this / gen_s
+            self._accum_spec_stats(out, stats)
 
             prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
                 self._host_process_chunk(batch, samples, stats, clock)
@@ -882,8 +905,10 @@ class PPOTrainer(TPUTrainer):
         what the importance ratio needs)."""
         gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
         batch = next(self.prompt_iterator)
+        spec_k = self._spec_k_effective()
         out = self.generate(batch["input_ids"], batch["attention_mask"], gen_kwargs,
-                            capture=self._fast_rollout_available())
+                            capture=self._fast_rollout_available(),
+                            **({"spec_k": spec_k} if spec_k else {}))
         return batch, out
 
     def _build_score_reward_fn(self, scalar_scores: bool):
@@ -1065,6 +1090,90 @@ class PPOTrainer(TPUTrainer):
             and getattr(self.config.method, "num_value_layers_unfrozen", 0) == 0
             and int(gen_kwargs.get("num_beams", 1) or 1) == 1
         )
+
+    # ------------------------------------------------------------------
+    # Self-speculative decode + int8 frozen-trunk decode view
+    # ------------------------------------------------------------------
+
+    def _spec_decode_available(self) -> bool:
+        """Whether generation may run the draft/verify speculative
+        sampler (method.speculative_decode). Needs a real hydra split
+        (the frozen trunk IS the draft model), a causal LM, no MoE (the
+        router recomputes per-token state the rollback can't unwind), no
+        prompt/prefix virtual tokens, single-beam sampling, and no
+        repetition penalty (its `seen` set is order-dependent across a
+        rejected draft). A refusal while the flag is on counts in
+        self.spec_decode_fallbacks — distinct from self.spec_fallbacks,
+        which counts the speculative SCORER's retokenization misses.
+        Overridden to False by the pipelined/sequence-parallel trainers,
+        whose param layouts can't run the split draft/verify applies."""
+        if not getattr(self.config.method, "speculative_decode", False):
+            return False
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        ok = (
+            not self.seq2seq
+            and self.split > 0
+            and getattr(self.model_cfg, "moe_experts", 0) == 0
+            and getattr(self.model_cfg, "prompt_tokens", 0) == 0
+            and getattr(self.model_cfg, "prefix_tokens", 0) == 0
+            and int(gen_kwargs.get("num_beams", 1) or 1) == 1
+            and float(gen_kwargs.get("repetition_penalty", 1.0) or 1.0) == 1.0
+        )
+        if not ok:
+            self.spec_decode_fallbacks = getattr(self, "spec_decode_fallbacks", 0) + 1
+        return ok
+
+    def _spec_k_effective(self) -> int:
+        return int(getattr(self.config.method, "spec_k", 4)) if self._spec_decode_available() else 0
+
+    def _accum_spec_stats(self, out, stats: Optional[Dict] = None):
+        """Fold a sampling dict's speculative counters into the trainer's
+        running totals (and, when given, a per-chunk stats dict). Called
+        only after the chunk's samples were already fetched, so these tiny
+        [b] reads never add a device sync."""
+        if "spec_rounds" not in out:
+            return
+        rounds = int(np.asarray(out["spec_rounds"]).sum())
+        accepted = int(np.asarray(out["spec_accepted"]).sum())
+        self.spec_decode_rounds = getattr(self, "spec_decode_rounds", 0) + rounds
+        self.spec_decode_accepted = getattr(self, "spec_decode_accepted", 0) + accepted
+        if stats is not None and rounds > 0:
+            k = int(getattr(self.config.method, "spec_k", 4))
+            stats["rollout/spec_accept_rate"] = accepted / float(k * rounds)
+            stats["rollout/spec_tokens_per_round"] = 1.0 + accepted / float(rounds)
+
+    def _spec_draft_head(self):
+        """Rank-`spec_draft_rank` SVD of the unembedding, computed once on
+        host (the tied embedding is frozen under any hydra split, so the
+        factors never go stale; an untied lm_head drifts — a draft-quality
+        effect only, the rejection correction keeps outputs exact)."""
+        cached = getattr(self, "_spec_draft_head_cache", None)
+        if cached is None:
+            from trlx_tpu.ops.sampling import spec_draft_head_from_params
+
+            rank = int(getattr(self.config.method, "spec_draft_rank", 64))
+            cached = spec_draft_head_from_params(self.params, self.model_cfg, rank)
+            self._spec_draft_head_cache = cached
+        return cached
+
+    def _decode_params(self):
+        """Sampler param view: the int8 frozen-trunk tree when
+        method.quantize_frozen_trunk is on (quantized ONCE — those leaves
+        never train — and re-merged with the live trainable leaves every
+        dispatch), else the dense merged tree."""
+        if not (
+            getattr(self.config.method, "quantize_frozen_trunk", False)
+            and self.split > 0
+            and not self.seq2seq
+        ):
+            return self.params
+        quant = getattr(self, "_quant_frozen_cache", None)
+        if quant is None:
+            from trlx_tpu.ops.quant import quantize_frozen_flat
+
+            quant = quantize_frozen_flat(self.frozen_params, self.split)
+            self._quant_frozen_cache = quant
+        return merge_params(self.train_params, quant)
 
     # ------------------------------------------------------------------
     # Frozen-trunk activation cache (method.cache_trunk_activations)
@@ -1444,6 +1553,8 @@ class PPOTrainer(TPUTrainer):
         fetched = jax.device_get(tuple(fetch))
         samples_list = fetched[:k]
         trimmed_list = fetched[k:2 * k] if use_spec else [None] * k
+        for _, o in gens:
+            self._accum_spec_stats(o)
 
         processed = None
         if use_fast:

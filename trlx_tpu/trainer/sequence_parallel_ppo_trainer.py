@@ -110,6 +110,39 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             )
         return False
 
+    def _spec_decode_available(self) -> bool:
+        """Speculative decode is unavailable here: rollouts run through
+        the sharded generate layout, and the draft/verify applies
+        (spec_draft_step / spec_verify_rows) live outside it — the plain
+        sampler stays in charge."""
+        if (
+            getattr(self.config.method, "speculative_decode", False)
+            and not getattr(self, "_warned_no_spec_decode", False)
+        ):
+            self._warned_no_spec_decode = True
+            logger.warning(
+                "method.speculative_decode is ignored under sequence "
+                "parallelism (the draft/verify applies do not run in the "
+                "sharded layout); sampling with the plain fused loop"
+            )
+        return False
+
+    def _decode_params(self):
+        """The int8 decode view is unavailable here: the sharded decode
+        path consumes the dense replicated tree — dense weights stay in
+        charge."""
+        if (
+            getattr(self.config.method, "quantize_frozen_trunk", False)
+            and not getattr(self, "_warned_no_quantize", False)
+        ):
+            self._warned_no_quantize = True
+            logger.warning(
+                "method.quantize_frozen_trunk is ignored under sequence "
+                "parallelism (the sharded decode path consumes dense "
+                "weights); sampling with dense weights"
+            )
+        return self.params
+
     # ------------------------------------------------------------------
     # Shared shard_map forward: per-position logprobs (+values, +ref)
     # ------------------------------------------------------------------

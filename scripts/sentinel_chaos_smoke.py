@@ -1,0 +1,132 @@
+"""CI smoke: a short CPU PPO run with the health sentinel ON and faults
+injected mid-run (one NaN-gradient step, two consecutive loss-spike
+steps). Passes when the run completes WITHOUT human intervention: at
+least one optimizer update was masked in-jit, at least one rewind to the
+pinned last_good checkpoint happened, and the final loss is finite.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/sentinel_chaos_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from trlx_tpu import resilience  # noqa: E402
+from trlx_tpu.data.configs import (  # noqa: E402
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline  # noqa: E402
+from trlx_tpu.trainer.ppo_trainer import PPOConfig, PPOTrainer  # noqa: E402
+from trlx_tpu.utils import set_seed  # noqa: E402
+
+
+def build_config(workdir: str) -> TRLConfig:
+    return TRLConfig(
+        train=TrainConfig(
+            seq_length=16,
+            epochs=4,
+            total_steps=8,
+            batch_size=8,
+            checkpoint_interval=100,
+            eval_interval=100,
+            pipeline="PromptPipeline",
+            trainer="PPOTrainer",
+            tracker="jsonl",
+            logging_dir=os.path.join(workdir, "logs"),
+            checkpoint_dir=os.path.join(workdir, "ckpts"),
+            seed=7,
+            sentinel=True,
+            grad_skip_threshold=50.0,
+            sentinel_window=8,
+            sentinel_warmup=2,
+            sentinel_skip_after=2,
+            sentinel_rewind_after=2,
+            sentinel_good_steps=1,
+            sentinel_pin_interval=1,
+            max_rewinds=4,
+            sentinel_cooldown_steps=4,
+        ),
+        model=ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=1),
+        tokenizer=TokenizerConfig(tokenizer_path="char:abcdefgh"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="constant"),
+        method=PPOConfig(
+            name="PPOConfig",
+            num_rollouts=8,
+            chunk_size=8,
+            ppo_epochs=2,
+            init_kl_coef=0.01,
+            target=None,
+            horizon=1000,
+            gamma=1.0,
+            lam=0.95,
+            cliprange=0.2,
+            cliprange_value=0.2,
+            vf_coef=1.0,
+            scale_reward=None,
+            ref_mean=None,
+            ref_std=None,
+            cliprange_reward=10,
+            gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        parallel=ParallelConfig(data=1, fsdp=1, tensor=1),
+    )
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="sentinel_chaos_")
+    config = build_config(workdir)
+    set_seed(config.train.seed)
+
+    trainer = PPOTrainer(
+        config, reward_fn=lambda samples, **kw: [float(s.count("a")) for s in samples]
+    )
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs["max_new_tokens"]
+    prompts = ["ab", "cd", "ef", "gh"] * 2
+    trainer.add_prompt_pipeline(PromptPipeline(prompts, max_prompt_length, trainer.tokenizer))
+    trainer.add_eval_pipeline(PromptPipeline(prompts, max_prompt_length, trainer.tokenizer))
+
+    trainer.fault_injector = resilience.FaultInjector(
+        nan_grad_steps=[2], loss_spike_steps=[4, 5], spike_scale=1e4
+    )
+    trainer.learn()
+
+    rows = []
+    for name in os.listdir(config.train.logging_dir):
+        if name.endswith(".metrics.jsonl"):
+            with open(os.path.join(config.train.logging_dir, name)) as f:
+                rows += [json.loads(line) for line in f if line.strip()]
+
+    skips = sum(r.get("train/skipped_updates", 0.0) for r in rows)
+    rewinds = max((r.get("sentinel/rewinds", 0.0) for r in rows), default=0.0)
+    final = [r for r in rows if "losses/total_loss" in r][-1]
+
+    assert trainer.iter_count == config.train.total_steps, (
+        f"run stopped at step {trainer.iter_count} / {config.train.total_steps}"
+    )
+    assert skips >= 1, f"no optimizer update was masked in-jit (skips={skips})"
+    assert rewinds >= 1, f"no rewind to last_good happened (rewinds={rewinds})"
+    assert np.isfinite(final["losses/total_loss"]), (
+        f"non-finite final loss: {final['losses/total_loss']}"
+    )
+    print(
+        f"sentinel chaos smoke OK: {config.train.total_steps} steps, "
+        f"{skips:.0f} skipped updates, {rewinds:.0f} rewinds, "
+        f"final loss {final['losses/total_loss']:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

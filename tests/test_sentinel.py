@@ -1,0 +1,656 @@
+"""Health sentinel tests (trlx_tpu/sentinel.py): the in-jit gradient
+guard, the anomaly-escalation ladder, rewind-and-skip recovery, rollout
+quarantine, the hang watchdog, and the flag-off bit-identity guarantee.
+Faults are injected deterministically via resilience.FaultInjector."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.data import PPORLBatch, PPORLElement
+from trlx_tpu.data.configs import (
+    ModelConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    SchedulerConfig,
+    TokenizerConfig,
+    TrainConfig,
+    TRLConfig,
+)
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.sentinel import (
+    LAST_GOOD_NAME,
+    HealthSentinel,
+    RollingStat,
+    SentinelRewind,
+    StepWatchdog,
+    repetition_frac,
+)
+from trlx_tpu.trainer.ppo_trainer import PPOConfig, PPOTrainer
+
+SENTINEL_DEFAULTS = dict(
+    sentinel=True,
+    grad_skip_threshold=50.0,
+    sentinel_window=8,
+    sentinel_warmup=2,
+    sentinel_zscore=8.0,
+    sentinel_skip_after=2,
+    sentinel_rewind_after=2,
+    sentinel_good_steps=1,
+    sentinel_pin_interval=1,
+    max_rewinds=4,
+    sentinel_cooldown_steps=4,
+)
+
+
+def ppo_config(tmp_path, **train_overrides):
+    train = dict(
+        seq_length=16,
+        epochs=2,
+        total_steps=4,
+        batch_size=8,
+        checkpoint_interval=100,
+        eval_interval=100,
+        pipeline="PromptPipeline",
+        trainer="PPOTrainer",
+        tracker=None,
+        checkpoint_dir=str(tmp_path / "ckpts"),
+        seed=7,
+    )
+    train.update(train_overrides)
+    return TRLConfig(
+        train=TrainConfig(**train),
+        model=ModelConfig(model_path="random:gpt2-tiny", num_layers_unfrozen=1),
+        tokenizer=TokenizerConfig(tokenizer_path="char:abcdefgh"),
+        optimizer=OptimizerConfig(name="adamw", kwargs=dict(lr=1e-3)),
+        scheduler=SchedulerConfig(name="constant"),
+        method=PPOConfig(
+            name="PPOConfig",
+            num_rollouts=8,
+            chunk_size=8,
+            ppo_epochs=2,
+            init_kl_coef=0.01,
+            target=None,
+            horizon=1000,
+            gamma=1.0,
+            lam=0.95,
+            cliprange=0.2,
+            cliprange_value=0.2,
+            vf_coef=1.0,
+            scale_reward=None,
+            ref_mean=None,
+            ref_std=None,
+            cliprange_reward=10,
+            gen_kwargs=dict(max_new_tokens=6, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        parallel=ParallelConfig(data=2, fsdp=2, tensor=2),
+    )
+
+
+def count_letters_reward(samples, **kwargs):
+    return [float(s.count("a")) for s in samples]
+
+
+def push_random_store(trainer, n=8, seed=3):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        m = 5
+        trainer.store.push([
+            PPORLElement(
+                query_tensor=rng.integers(3, 8, size=4).astype(np.int32),
+                response_tensor=rng.integers(3, 8, size=m).astype(np.int32),
+                logprobs=rng.normal(size=m).astype(np.float32),
+                values=rng.normal(size=m).astype(np.float32),
+                rewards=rng.normal(size=m).astype(np.float32),
+            )
+        ])
+
+
+def build_learning_trainer(config, reward_fn=count_letters_reward,
+                           prompts=None, eval_prompts=None):
+    """Replicate trlx.train's wiring but return the trainer BEFORE
+    learn(), so tests can instrument save/load/fault hooks."""
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.utils import set_seed
+
+    set_seed(config.train.seed)
+    trainer = PPOTrainer(config, reward_fn=reward_fn)
+    max_prompt_length = config.train.seq_length - config.method.gen_kwargs.get(
+        "max_new_tokens", 40
+    )
+    prompts = prompts or ["ab", "cd", "ef", "gh"] * 2
+    eval_prompts = eval_prompts or prompts[: config.train.batch_size]
+    trainer.add_prompt_pipeline(
+        PromptPipeline(prompts, max_prompt_length, trainer.tokenizer)
+    )
+    trainer.add_eval_pipeline(
+        PromptPipeline(eval_prompts, max_prompt_length, trainer.tokenizer)
+    )
+    return trainer
+
+
+def read_rows(logging_dir):
+    rows = []
+    for name in os.listdir(logging_dir):
+        if name.endswith(".metrics.jsonl"):
+            with open(os.path.join(logging_dir, name)) as f:
+                rows += [json.loads(line) for line in f if line.strip()]
+    return rows
+
+
+# ----------------------------------------------------------------------
+# Unit: rolling stats + escalation ladder
+# ----------------------------------------------------------------------
+
+
+def test_rolling_stat_robust_zscore():
+    w = RollingStat(window=16, warmup=4)
+    for v in [1.0, 1.1, 0.9, 1.05, 1.0]:
+        assert w.zscore(v) < 8.0
+        w.push(v)
+    assert w.ready
+    assert w.zscore(100.0) > 8.0
+    assert w.zscore(float("nan")) == float("inf")
+    # anomalous values are NOT meant to be pushed: the window must not
+    # chase the spike
+    before = len(w)
+    w.push(float("nan"))
+    assert len(w) == before
+
+
+def test_ladder_warn_skip_rewind_abort():
+    s = HealthSentinel(window=8, warmup=2, zscore=6.0, skip_after=2,
+                       rewind_after=3, max_rewinds=1, good_steps=1)
+    for i in range(4):
+        assert s.observe_step({"loss": 1.0 + 0.01 * i}, i).action == "ok"
+    assert s.observe_step({"loss": 900.0}, 4).action == "warn"
+    assert s.observe_step({"loss": 900.0}, 5).action == "skip"
+    # no last_good pinned yet: the rewind rung falls through to abort
+    v = s.observe_step({"loss": 900.0}, 6)
+    assert v.action == "abort"
+    assert any("no last_good" in r for r in v.reasons)
+    # with a pin, the same rung rewinds; after the budget is spent, aborts
+    s.anomaly_streak = 0
+    s.note_pinned("/tmp/pin", 3)
+    for i in range(7, 10):
+        v = s.observe_step({"loss": 900.0}, i)
+    assert v.action == "rewind"
+    s.note_rewind(9)
+    s.anomaly_streak = 2
+    v = s.observe_step({"loss": 900.0}, 10)
+    assert v.action == "abort"
+    assert any("budget exhausted" in r for r in v.reasons)
+
+
+def test_nan_guard_policy_forces_ladder_top():
+    """nan_guard_patience consecutive non-finite losses escalate straight
+    to rewind/abort regardless of the anomaly streak (the legacy binary
+    nan_guard as one sentinel policy)."""
+    s = HealthSentinel(window=8, warmup=2, zscore=6.0, rewind_after=99,
+                       nan_guard=True, nan_guard_patience=2, max_rewinds=1)
+    for i in range(3):
+        s.observe_step({"loss": 1.0}, i)
+    assert s.observe_step({"loss": float("nan")}, 3).action == "warn"
+    assert s.observe_step({"loss": float("nan")}, 4).action == "abort"
+    s2 = HealthSentinel(window=8, warmup=2, zscore=6.0, rewind_after=99,
+                        nan_guard=True, nan_guard_patience=2, max_rewinds=1)
+    s2.note_pinned("/tmp/pin", 0)
+    s2.observe_step({"loss": float("nan")}, 1)
+    assert s2.observe_step({"loss": float("nan")}, 2).action == "rewind"
+
+
+def test_sentinel_state_roundtrip():
+    s = HealthSentinel(window=8, warmup=2)
+    for i in range(6):
+        s.observe_step({"loss": float(i % 3)}, i)
+    s.note_pinned("/tmp/pin", 4)
+    s.note_rewind(5)
+    s.record_skipped(2)
+    s.quarantined_rows = 3
+    restored = HealthSentinel(window=8, warmup=2)
+    restored.load_state_dict(s.state_dict())
+    assert restored.state_dict() == s.state_dict()
+    assert restored.rewinds_used == 1
+    assert restored.last_good["step"] == 4
+
+
+def test_rollout_anomalies_fold_into_next_step_verdict():
+    s = HealthSentinel(window=8, warmup=2, zscore=6.0, skip_after=1,
+                       rewind_after=99)
+    for i in range(4):
+        s.observe_rollout({"rollout_scores/mean": 1.0 + 0.01 * i})
+        s.observe_step({"loss": 1.0}, i)
+    assert s.observe_rollout({"rollout_scores/mean": 500.0})
+    v = s.observe_step({"loss": 1.0}, 5)
+    assert v.action == "skip"
+    assert any("rollout_scores/mean" in r for r in v.reasons)
+
+
+# ----------------------------------------------------------------------
+# Unit: quarantine
+# ----------------------------------------------------------------------
+
+
+def test_quarantine_mask_outliers_and_degenerates():
+    s = HealthSentinel(window=16, warmup=4, quarantine_zscore=6.0,
+                       min_response_tokens=2, max_repetition_frac=0.9)
+    # warm the reward window with clean chunks
+    for _ in range(2):
+        scores = np.array([1.0, 1.1, 0.9, 1.05])
+        drop = s.quarantine_mask(scores, np.full(4, 6), np.full(4, 0.3))
+        assert not drop.any()
+    scores = np.array([1.0, 900.0, 1.1, 0.95, 1.02, 0.98, 1.07, 0.93])
+    lens = np.array([6, 6, 1, 6, 6, 6, 6, 6])       # row 2: length collapse
+    reps = np.array([0.3, 0.3, 0.3, 0.99, 0.3, 0.3, 0.3, 0.3])  # row 3: repetition
+    drop = s.quarantine_mask(scores, lens, reps)
+    assert drop.tolist() == [False, True, True, True, False, False, False, False]
+    assert s.quarantined_rows == 3
+
+
+def test_quarantine_keeps_all_when_majority_flags():
+    """>50% of a chunk flagged means the baseline can't be trusted: keep
+    everything instead of starving the store."""
+    s = HealthSentinel(window=16, warmup=2, quarantine_zscore=4.0,
+                       min_response_tokens=2, max_repetition_frac=0.9)
+    for _ in range(2):
+        s.quarantine_mask(np.array([1.0, 1.0, 1.0]), np.full(3, 6), np.full(3, 0.3))
+    drop = s.quarantine_mask(
+        np.array([500.0, 600.0, 1.0]), np.array([1, 6, 6]), np.full(3, 0.3)
+    )
+    assert not drop.any()
+
+
+def test_repetition_frac():
+    assert repetition_frac([1, 1, 1, 1]) == 1.0
+    assert repetition_frac([1, 2, 3, 4]) == 0.25
+    assert repetition_frac([]) == 1.0
+
+
+# ----------------------------------------------------------------------
+# Unit: watchdog
+# ----------------------------------------------------------------------
+
+
+def test_watchdog_fires_and_dumps_stacks(capfd):
+    fired = []
+    dog = StepWatchdog(timeout_s=0.15, on_timeout=lambda: fired.append(time.monotonic()))
+    dog.start()
+    time.sleep(0.6)
+    dog.stop()
+    assert dog.fired and len(fired) == 1
+    err = capfd.readouterr().err
+    assert "(most recent call first)" in err  # faulthandler stack dump
+
+
+def test_watchdog_beats_prevent_firing():
+    dog = StepWatchdog(timeout_s=0.25, on_timeout=lambda: None)
+    dog.start()
+    for _ in range(6):
+        time.sleep(0.08)
+        dog.beat()
+    dog.stop()
+    assert not dog.fired
+
+
+def test_watchdog_default_is_preemption_exit():
+    dog = StepWatchdog(timeout_s=10.0)
+    assert dog.on_timeout is None  # default path: os._exit(75)
+    assert resilience.PREEMPTION_EXIT_CODE == 75
+
+
+def test_learn_starts_and_stops_watchdog(tmp_path):
+    config = ppo_config(tmp_path, step_timeout_s=300.0)
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    t.prepare_learning = lambda: None
+    t.evaluate = lambda: {}
+    t.total_steps, t.n_inner_epochs = 1, 1
+    seen = {}
+
+    def fake_loop(best, clock):
+        seen["watchdog"] = t._watchdog
+        return {}
+
+    t._learn_loop = fake_loop
+    t.learn()
+    assert isinstance(seen["watchdog"], StepWatchdog)
+    assert seen["watchdog"].timeout_s == 300.0
+    assert t._watchdog is None  # stopped and cleared on exit
+
+
+# ----------------------------------------------------------------------
+# Unit: fault injector train faults + gc retention
+# ----------------------------------------------------------------------
+
+
+def test_fault_injector_train_faults_are_one_shot():
+    fi = resilience.FaultInjector(nan_grad_steps=[2], loss_spike_steps=[2, 5],
+                                  hang_steps=[7])
+    assert fi.train_fault(0) is None
+    assert fi.train_fault(2) == "nan_grad"   # nan wins over spike at 2
+    assert fi.train_fault(2) == "loss_spike"  # next consult: spike still pending
+    assert fi.train_fault(2) is None          # one-shot: replay trains clean
+    assert fi.train_fault(5) == "loss_spike"
+    assert fi.train_fault(7) == "hang"
+    assert fi.train_fault(7) is None
+    assert fi.injected == 4
+
+
+def test_fault_injector_poisons_rewards_only():
+    b = PPORLBatch(
+        query_tensors=np.ones((2, 3), np.int32),
+        response_tensors=np.ones((2, 4), np.int32),
+        logprobs=np.ones((2, 4), np.float32),
+        values=np.ones((2, 4), np.float32),
+        rewards=np.ones((2, 4), np.float32),
+    )
+    fi = resilience.FaultInjector(nan_grad_steps=[0], spike_scale=100.0)
+    nan_b = fi.poison_batch(b, "nan_grad")
+    assert np.isnan(np.asarray(nan_b.rewards)).all()
+    np.testing.assert_array_equal(np.asarray(nan_b.logprobs), np.asarray(b.logprobs))
+    spike_b = fi.poison_batch(b, "loss_spike")
+    np.testing.assert_array_equal(np.asarray(spike_b.rewards), 100.0 * np.asarray(b.rewards))
+    assert fi.poison_batch(b, "hang") is b
+
+
+def test_gc_never_deletes_last_good_or_best(tmp_path):
+    ckpt_dir = str(tmp_path / "ckpts")
+    for i, name in enumerate(
+        ["checkpoint_1", "checkpoint_2", "checkpoint_3", LAST_GOOD_NAME, "best_checkpoint"]
+    ):
+        d = os.path.join(ckpt_dir, name)
+        os.makedirs(d)
+        with open(os.path.join(d, "data.bin"), "w") as f:
+            f.write("x")
+        resilience.write_manifest(d, step=i + 1)
+    deleted = resilience.gc_checkpoints(ckpt_dir, keep_n=1)
+    remaining = sorted(os.listdir(ckpt_dir))
+    assert remaining == sorted(["checkpoint_3", LAST_GOOD_NAME, "best_checkpoint"])
+    assert sorted(os.path.basename(p) for p in deleted) == ["checkpoint_1", "checkpoint_2"]
+
+
+# ----------------------------------------------------------------------
+# Integration: in-jit skip on NaN grads (no recompile, params untouched)
+# ----------------------------------------------------------------------
+
+
+def test_skip_update_on_injected_nan_grads(tmp_path):
+    import jax
+
+    config = ppo_config(tmp_path, **SENTINEL_DEFAULTS)
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    push_random_store(t, n=16)
+    loader = t.store.create_loader(8, shuffle=False)
+    mbs = list(MiniBatchIterator(loader, t.mb_size, t.num_mb))
+
+    stats0 = jax.device_get(t.train_minibatch(mbs[0]))  # clean step compiles
+    assert stats0["train"]["skipped_updates"] == 0.0
+    assert np.isfinite(stats0["train"]["grad_global_norm"])
+    cache_after_clean = t._train_step_fn._cache_size()
+
+    params_before = jax.device_get(t.train_params)
+    opt_before = jax.device_get(t.opt_state)
+    t.fault_injector = resilience.FaultInjector(nan_grad_steps=[0])
+    stats1 = jax.device_get(t.train_minibatch(mbs[1]))
+
+    assert stats1["train"]["skipped_updates"] == 1.0
+    assert not np.isfinite(stats1["train"]["grad_global_norm"])
+    # in-jit masking: no recompile for the poisoned step
+    assert t._train_step_fn._cache_size() == cache_after_clean
+    # params and optimizer state pass through bit-identically
+    for k in params_before:
+        np.testing.assert_array_equal(
+            np.asarray(params_before[k]), np.asarray(t.train_params[k]), err_msg=str(k)
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(opt_before), jax.tree_util.tree_leaves(jax.device_get(t.opt_state))
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grad_skip_threshold_masks_finite_spikes(tmp_path):
+    import jax
+
+    config = ppo_config(tmp_path, **SENTINEL_DEFAULTS)
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    push_random_store(t, n=16)
+    loader = t.store.create_loader(8, shuffle=False)
+    mbs = list(MiniBatchIterator(loader, t.mb_size, t.num_mb))
+    t.train_minibatch(mbs[0])
+    params_before = jax.device_get(t.train_params)
+    t.fault_injector = resilience.FaultInjector(loss_spike_steps=[0], spike_scale=1e6)
+    stats = jax.device_get(t.train_minibatch(mbs[1]))
+    assert stats["train"]["skipped_updates"] == 1.0
+    assert np.isfinite(stats["train"]["grad_global_norm"])  # finite but huge
+    for k in params_before:
+        np.testing.assert_array_equal(
+            np.asarray(params_before[k]), np.asarray(t.train_params[k]), err_msg=str(k)
+        )
+
+
+# ----------------------------------------------------------------------
+# Integration: flag off == flag on (clean) bit-identity
+# ----------------------------------------------------------------------
+
+
+def test_sentinel_on_clean_run_matches_off(tmp_path):
+    """With the sentinel ON but no anomalies, the guarded train step
+    (updates * lr_scale, where(ok, ...)) matches the plain one to within
+    XLA fusion reordering: the extra global_norm consumer of the grads
+    can change reduction tiling by ~1 ulp, but nothing more. (With the
+    flag OFF the graphs are textually identical, hence bit-exact vs
+    main — that path needs no tolerance.)"""
+    import jax
+
+    def run(sub, sentinel):
+        overrides = dict(SENTINEL_DEFAULTS, sentinel=sentinel) if sentinel else {}
+        config = ppo_config(tmp_path / sub, **overrides)
+        t = PPOTrainer(config, reward_fn=count_letters_reward)
+        push_random_store(t, n=16)
+        loader = t.store.create_loader(8, shuffle=False)
+        for mb in MiniBatchIterator(loader, t.mb_size, t.num_mb):
+            t.train_minibatch(mb)
+            t.iter_count += 1
+        return jax.device_get(t.train_params)
+
+    p_off = run("off", sentinel=False)
+    p_on = run("on", sentinel=True)
+    assert set(p_off) == set(p_on)
+    for k in p_off:
+        np.testing.assert_allclose(
+            np.asarray(p_off[k], np.float32),
+            np.asarray(p_on[k], np.float32),
+            rtol=1e-5,
+            atol=1e-8,
+            err_msg=str(k),
+        )
+
+
+# ----------------------------------------------------------------------
+# Integration: rewind-and-skip through a full chaos learn()
+# ----------------------------------------------------------------------
+
+
+def test_chaos_run_skips_rewinds_and_completes(tmp_path):
+    """A PPO run with an injected NaN-grad step and two consecutive
+    loss-spike steps completes without human intervention: the NaN step
+    is skipped in-jit, the spike streak triggers a rewind to last_good
+    (bit-identical params/opt-state/PRNG), and sentinel/* stats appear in
+    the tracker output."""
+    import jax
+
+    config = ppo_config(
+        tmp_path,
+        epochs=4,
+        total_steps=8,
+        tracker="jsonl",
+        logging_dir=str(tmp_path / "logs"),
+        **SENTINEL_DEFAULTS,
+    )
+    trainer = build_learning_trainer(config)
+    trainer.fault_injector = resilience.FaultInjector(
+        nan_grad_steps=[2], loss_spike_steps=[4, 5], spike_scale=1e4
+    )
+
+    pins, restores = [], []
+    orig_save, orig_load = trainer.save, trainer.load
+
+    def capturing_save(path=None):
+        if path and os.path.basename(path) == LAST_GOOD_NAME:
+            pins.append({
+                "step": trainer.iter_count,
+                "params": jax.device_get(trainer.train_params),
+                "opt": jax.device_get(trainer.opt_state),
+                "rng": np.asarray(trainer.rng).copy(),
+            })
+        orig_save(path)
+
+    def capturing_load(path):
+        orig_load(path)
+        if os.path.basename(path) == LAST_GOOD_NAME:
+            restores.append({
+                "step": trainer.iter_count,
+                "params": jax.device_get(trainer.train_params),
+                "opt": jax.device_get(trainer.opt_state),
+                "rng": np.asarray(trainer.rng).copy(),
+            })
+
+    trainer.save, trainer.load = capturing_save, capturing_load
+    trainer.learn()
+
+    assert trainer.iter_count == 8
+    assert trainer._sentinel.rewinds_used >= 1
+    assert trainer._sentinel.skipped_updates >= 1
+
+    # the restore is bit-identical to the matching pin: params, optimizer
+    # state, and PRNG key all exact-equal
+    assert pins and restores
+    restored = restores[0]
+    pin = [p for p in pins if p["step"] == restored["step"]][-1]
+    np.testing.assert_array_equal(pin["rng"], restored["rng"])
+    for k in pin["params"]:
+        np.testing.assert_array_equal(
+            np.asarray(pin["params"][k]), np.asarray(restored["params"][k]), err_msg=str(k)
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pin["opt"]), jax.tree_util.tree_leaves(restored["opt"])
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # last_good survives on disk (gc carve-out) and is manifest-complete
+    last_good = os.path.join(config.train.checkpoint_dir, LAST_GOOD_NAME)
+    assert resilience.is_valid_checkpoint(last_good)
+
+    # tracker rows: the skipped step, the rewind counter, and a finite end
+    rows = read_rows(config.train.logging_dir)
+    train_rows = [r for r in rows if "train/skipped_updates" in r]
+    assert any(r["train/skipped_updates"] >= 1.0 for r in train_rows)
+    assert max(r.get("sentinel/rewinds", 0.0) for r in rows) >= 1.0
+    assert any("sentinel/quarantined_rows" in r for r in rows)
+    final = [r for r in train_rows if r["_step"] == 8][-1]
+    assert np.isfinite(final["losses/total_loss"])
+
+
+def test_rewind_budget_exhaustion_aborts_with_stats_flushed(tmp_path):
+    """With no pin available (good_steps huge) a spike streak falls
+    through the rewind rung to abort, and the fatal step's stats reach
+    the tracker before the raise."""
+    config = ppo_config(
+        tmp_path,
+        epochs=4,
+        total_steps=8,
+        tracker="jsonl",
+        logging_dir=str(tmp_path / "logs"),
+        **dict(SENTINEL_DEFAULTS, sentinel_good_steps=1000, max_rewinds=0),
+    )
+    trainer = build_learning_trainer(config)
+    trainer.fault_injector = resilience.FaultInjector(
+        loss_spike_steps=[2, 3], spike_scale=1e4
+    )
+    with pytest.raises(FloatingPointError, match="sentinel abort"):
+        trainer.learn()
+    fatal_step = trainer.iter_count
+    rows = read_rows(config.train.logging_dir)
+    fatal_rows = [r for r in rows if r["_step"] == fatal_step and "losses/total_loss" in r]
+    assert fatal_rows, "fatal step's stats were not flushed to the tracker"
+    assert any("sentinel/anomaly_streak" in r for r in fatal_rows)
+
+
+def test_legacy_nan_guard_flushes_fatal_stats(tmp_path):
+    """Satellite: with the sentinel OFF, the legacy nan_guard now logs the
+    diverged step's stats before raising."""
+    config = ppo_config(
+        tmp_path, tracker="jsonl", logging_dir=str(tmp_path / "logs")
+    )
+    config.train.nan_guard_patience = 1
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    t.iter_count = 3
+    with pytest.raises(FloatingPointError, match="diverged"):
+        t._check_divergence({"losses/total_loss": float("nan")})
+    rows = read_rows(config.train.logging_dir)
+    assert any(r["_step"] == 3 for r in rows)
+
+
+# ----------------------------------------------------------------------
+# Integration: rollout quarantine inside make_experience
+# ----------------------------------------------------------------------
+
+
+def test_make_experience_quarantines_injected_outliers(tmp_path):
+    """An injected reward outlier is masked out of the store, and the
+    under-filled collection dispatches extra chunks to compensate."""
+    config = ppo_config(
+        tmp_path,
+        **dict(
+            SENTINEL_DEFAULTS,
+            sentinel_quarantine_zscore=6.0,
+            sentinel_min_response_tokens=0,
+            sentinel_max_repetition_frac=1.1,
+        ),
+    )
+    config.method.num_rollouts = 16
+    calls = {"n": 0}
+
+    def outlier_reward(samples, **kwargs):
+        # tightly distributed so MAD is small but nonzero: only the
+        # injected outlier should cross the quarantine z-threshold
+        rewards = [1.0 + 0.05 * (j % 4) for j in range(len(samples))]
+        calls["n"] += 1
+        if calls["n"] == 3:  # first window-warming chunks stay clean
+            rewards[0] = 1e6
+        return rewards
+
+    trainer = build_learning_trainer(config, reward_fn=outlier_reward)
+    trainer.make_experience(8, iter_count=0)   # warm the reward window
+    trainer.store.clear_history()
+    trainer.make_experience(16, iter_count=1)  # call 3 injects the outlier
+    assert trainer._sentinel.quarantined_rows >= 1
+    # the store still fills: quarantined rows are replaced by extra chunks
+    assert len(trainer.store.history) >= 16
+
+
+# ----------------------------------------------------------------------
+# Persistence: sentinel state rides in extra_state.pkl
+# ----------------------------------------------------------------------
+
+
+def test_sentinel_state_rides_in_checkpoint(tmp_path):
+    config = ppo_config(tmp_path, **SENTINEL_DEFAULTS)
+    t = PPOTrainer(config, reward_fn=count_letters_reward)
+    t._sentinel.record_skipped(2)
+    t._sentinel.note_pinned("/tmp/pin", 4)
+    t._sentinel.observe_step({"loss": 1.0}, 1)
+    extra = t._extra_resume_state()
+    assert "sentinel" in extra and "store_history" in extra
+
+    directory = str(tmp_path / "ckpts" / "checkpoint_test")
+    t.save(directory)
+    t2 = PPOTrainer(ppo_config(tmp_path / "re", **SENTINEL_DEFAULTS),
+                    reward_fn=count_letters_reward)
+    t2.load(directory)
+    assert t2._sentinel.skipped_updates == 2.0
+    assert t2._sentinel.last_good["step"] == 4
+    assert t2._sentinel.state_dict() == t._sentinel.state_dict()

@@ -277,13 +277,77 @@ class TrainConfig:
     profile_start: int = 2
     profile_stop: int = 4
 
-    # Divergence guard (goes beyond the reference, which has no failure
-    # detection at all — SURVEY.md §5.3): warn on each step with a
-    # non-finite loss and, after `nan_guard_patience` consecutive bad
-    # steps, abort with a clear error BEFORE any checkpoint write so the
-    # last good checkpoint survives.
+    # --- Health sentinel (trlx_tpu/sentinel.py) -----------------------
+    # Self-healing training (the reference has no failure detection at
+    # all — SURVEY.md §5.3). `sentinel` is the master switch for the
+    # four-layer subsystem: (1) an in-jit gradient guard that skips the
+    # optimizer update when the global grad norm is non-finite or above
+    # `grad_skip_threshold` (jnp.where-masked inside the compiled step —
+    # no recompile, no host round trip); (2) rolling median/MAD anomaly
+    # detection over loss, grad norm, approx_kl, reward mean, and
+    # entropy with an escalation ladder warn -> skip-chunk -> rewind ->
+    # abort; (3) rewind-and-skip recovery from a pinned `last_good`
+    # checkpoint with a `max_rewinds` budget and an LR-damp/KL-boost
+    # cooldown; (4) a step hang watchdog (`step_timeout_s`). Off
+    # (default) keeps training bit-identical to the pre-sentinel
+    # trainer: the compiled train step is built without the guard.
+    sentinel: bool = False
+    # Skip the update in-jit when the global grad norm exceeds this
+    # (non-finite norms are always skipped when the sentinel is on);
+    # None = skip on non-finite only. Surfaced per step as
+    # train/grad_global_norm and train/skipped_updates.
+    grad_skip_threshold: Optional[float] = None
+    # Non-finite-loss policy (legacy names kept so existing configs work
+    # unchanged — this was the standalone "nan_guard" before the
+    # sentinel subsumed it). Sentinel off: warn each bad step and abort
+    # after `nan_guard_patience` consecutive ones, BEFORE any checkpoint
+    # write so the last good checkpoint survives. Sentinel on: the same
+    # streak instead escalates through the ladder (rewind before abort).
     nan_guard: bool = True
     nan_guard_patience: int = 3
+    # Rolling anomaly detection: each monitored metric keeps a
+    # `sentinel_window`-sample window of clean history; a new sample
+    # further than `sentinel_zscore` robust (median/MAD) z-scores from
+    # the window median is anomalous. Detection starts once a metric
+    # has `sentinel_warmup` samples.
+    sentinel_window: int = 32
+    sentinel_zscore: float = 8.0
+    sentinel_warmup: int = 8
+    # Escalation ladder: consecutive anomalous steps before each rung —
+    # warn on the first, drop the current rollout chunk (skip-chunk) at
+    # `sentinel_skip_after`, rewind to `last_good` at
+    # `sentinel_rewind_after`; a rewind with no budget (or no pin yet)
+    # falls through to the abort.
+    sentinel_skip_after: int = 2
+    sentinel_rewind_after: int = 3
+    # The last_good checkpoint is (re)pinned after this many consecutive
+    # clean steps, at most once per `sentinel_pin_interval` steps (each
+    # pin is one full checkpoint write to <checkpoint_dir>/last_good;
+    # never garbage-collected).
+    sentinel_good_steps: int = 4
+    sentinel_pin_interval: int = 10
+    # Total rewinds allowed before falling through to the abort.
+    max_rewinds: int = 2
+    # Post-rewind cooldown: for this many steps the optimizer update is
+    # scaled by `sentinel_lr_damp` and (PPO) the KL penalty coefficient
+    # is multiplied by `sentinel_kl_boost`.
+    sentinel_cooldown_steps: int = 8
+    sentinel_lr_damp: float = 0.5
+    sentinel_kl_boost: float = 1.0
+    # Rollout quarantine (PPO make_experience): drop reward-outlier rows
+    # (> this many robust z-scores from the rolling per-sample reward
+    # median) and degenerate rows (response shorter than
+    # `sentinel_min_response_tokens`, or one token making up more than
+    # `sentinel_max_repetition_frac` of it) before they enter the PPO
+    # store; dropped rows are regenerated. 0 disables the quarantine.
+    sentinel_quarantine_zscore: float = 0.0
+    sentinel_min_response_tokens: int = 2
+    sentinel_max_repetition_frac: float = 0.95
+    # Hang watchdog: if no step boundary is reached for this many
+    # seconds, dump every thread's stack (faulthandler) and exit with
+    # code 75 (EX_TEMPFAIL) so auto_resume restarts the run. None
+    # disables. Active only inside learn().
+    step_timeout_s: Optional[float] = None
 
     # Generation shape buckets: round generate batches up to multiples of
     # 8 rows / 32 prompt columns (masked padding, outputs trimmed back)

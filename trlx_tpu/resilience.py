@@ -49,6 +49,13 @@ PREEMPTION_EXIT_CODE = 75
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
 
+# Checkpoint basenames the retention policy must never delete:
+# `best_checkpoint` tracks the best eval reward; `last_good` is the
+# health sentinel's pinned rewind target (trlx_tpu/sentinel.py) — if gc
+# removed it, the sentinel's recovery ladder would fall straight through
+# to abort.
+PROTECTED_CHECKPOINT_NAMES = ("best_checkpoint", "last_good")
+
 
 class PreemptionInterrupt(BaseException):
     """Raised at a step boundary after a preemption signal; derives from
@@ -215,15 +222,16 @@ def find_latest_valid_checkpoint(checkpoint_dir: str) -> Optional[str]:
 
 def gc_checkpoints(checkpoint_dir: str, keep_n: int) -> List[str]:
     """Retention policy: keep the newest `keep_n` step checkpoints, never
-    deleting `best_checkpoint` (not a step checkpoint) or the latest.
-    keep_n <= 0 keeps everything. Returns the deleted paths."""
+    deleting a protected checkpoint (`best_checkpoint`, the sentinel's
+    pinned `last_good`) or the latest. keep_n <= 0 keeps everything.
+    Returns the deleted paths."""
     if keep_n <= 0:
         return []
     keep_n = max(keep_n, 1)  # the latest is always kept
     candidates = [
         (step, wall, path)
         for step, wall, path in list_checkpoints(checkpoint_dir)
-        if os.path.basename(path) != "best_checkpoint"
+        if os.path.basename(path) not in PROTECTED_CHECKPOINT_NAMES
     ]
     deleted = []
     for _, _, path in candidates[:-keep_n]:
@@ -232,7 +240,7 @@ def gc_checkpoints(checkpoint_dir: str, keep_n: int) -> List[str]:
     if deleted:
         logger.info(
             f"Checkpoint GC: removed {len(deleted)} old checkpoint(s), "
-            f"keeping newest {keep_n} + best"
+            f"keeping newest {keep_n} + protected"
         )
     return deleted
 
@@ -478,6 +486,15 @@ class FaultInjector:
     overrides the checkpoint step a server reports (simulating a replica
     stuck behind the weight sync) without producing real checkpoints, and
     `kill_replica` takes a whole in-process server down mid-rollout.
+
+    Train-side faults for sentinel tests (trlx_tpu/sentinel.py): the
+    trainer consults `train_fault(step)` before each optimizer step and,
+    per the schedule, poisons the minibatch rewards with NaN (NaN loss ->
+    NaN grads end to end), scales them by `spike_scale` (a large but
+    finite loss spike), or sleeps `hang_step_s` (a wedged step for the
+    watchdog). Each scheduled step fires AT MOST ONCE — after a sentinel
+    rewind the loop replays the same iter_count range, and re-injecting
+    the same fault would pin the run in an infinite rewind cycle.
     """
 
     def __init__(
@@ -490,6 +507,11 @@ class FaultInjector:
         hang_s: float = 30.0,
         slow_s: float = 0.25,
         stale_checkpoint_step: Optional[int] = None,
+        nan_grad_steps: Iterable[int] = (),
+        loss_spike_steps: Iterable[int] = (),
+        hang_steps: Iterable[int] = (),
+        spike_scale: float = 1e4,
+        hang_step_s: float = 30.0,
     ):
         self.rate = rate
         self.mode = mode
@@ -498,6 +520,12 @@ class FaultInjector:
         self.hang_s = float(hang_s)
         self.slow_s = float(slow_s)
         self.stale_checkpoint_step = stale_checkpoint_step
+        self.nan_grad_steps = set(int(s) for s in nan_grad_steps)
+        self.loss_spike_steps = set(int(s) for s in loss_spike_steps)
+        self.hang_steps = set(int(s) for s in hang_steps)
+        self.spike_scale = float(spike_scale)
+        self.hang_step_s = float(hang_step_s)
+        self._fired_train_steps: set = set()
         self._rng = random.Random(seed)
         self._calls = 0
         self.injected = 0
@@ -516,6 +544,54 @@ class FaultInjector:
         if fail:
             self.injected += 1
         return fail
+
+    # -- train-side faults (sentinel tests) -------------------------------
+
+    def train_fault(self, step: int) -> Optional[str]:
+        """Fault scheduled for optimizer step `step`, or None. One-shot:
+        the same (step, fault) never fires twice, so a post-rewind replay
+        of the step range trains clean. Priority nan > spike > hang when
+        a step appears in several schedules."""
+        step = int(step)
+        for fault, steps in (
+            ("nan_grad", self.nan_grad_steps),
+            ("loss_spike", self.loss_spike_steps),
+            ("hang", self.hang_steps),
+        ):
+            if step in steps and (step, fault) not in self._fired_train_steps:
+                self._fired_train_steps.add((step, fault))
+                self.injected += 1
+                return fault
+        return None
+
+    def poison_batch(self, batch, fault: str):
+        """Return `batch` with its rewards poisoned per `fault`:
+        "nan_grad" turns every reward NaN (the loss and therefore every
+        gradient leaf go NaN); "loss_spike" multiplies rewards by
+        `spike_scale` (large finite loss, finite but huge grads). Works
+        on any flax struct with a float `rewards` leaf (PPORLBatch);
+        other batch types fall back to poisoning all float leaves."""
+        if fault == "hang":
+            return batch
+        factor = float("nan") if fault == "nan_grad" else self.spike_scale
+        if hasattr(batch, "rewards") and hasattr(batch, "replace"):
+            return batch.replace(rewards=batch.rewards * factor)
+        import jax.numpy as jnp
+        from jax import tree_util
+
+        def _poison(leaf):
+            if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf * factor
+            return leaf
+
+        return tree_util.tree_map(_poison, batch)
+
+    def maybe_hang(self, fault: Optional[str]) -> None:
+        """Block the calling (training) thread for `hang_step_s` when the
+        fault is "hang" — from the watchdog's perspective the step has
+        wedged."""
+        if fault == "hang":
+            time.sleep(self.hang_step_s)
 
     # -- replica death ----------------------------------------------------
 

@@ -31,6 +31,7 @@ import optax
 from flax import traverse_util
 
 from trlx_tpu import resilience
+from trlx_tpu.sentinel import LAST_GOOD_NAME, HealthSentinel, SentinelRewind, StepWatchdog
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models import resolve_split, trainable_mask
 from trlx_tpu.parallel import MeshRuntime, infer_param_shardings
@@ -179,6 +180,18 @@ class TPUTrainer(BaseRLTrainer):
         # _resume_pos by load() so a resumed run replays the exact same
         # shuffles and minibatch order.
         self._nan_streak = 0
+        # Health sentinel (trlx_tpu/sentinel.py): built only when
+        # train.sentinel is on — with it off, every code path below is
+        # textually identical to the pre-sentinel trainer.
+        self._sentinel = HealthSentinel.from_train_config(config.train) if config.train.sentinel else None
+        self._watchdog: Optional[StepWatchdog] = None
+        # injectable for tests (the default on timeout is os._exit(75))
+        self._watchdog_on_timeout = None
+        self._sentinel_skip_chunk = False
+        # Deterministic train-side fault injection (tests/CI chaos runs):
+        # assign a resilience.FaultInjector with nan_grad_steps /
+        # loss_spike_steps / hang_steps before learn().
+        self.fault_injector: Optional[resilience.FaultInjector] = None
         self._loop_pos: Optional[Dict[str, int]] = None
         self._resume_pos: Optional[Dict[str, int]] = None
         self._resume_dir: Optional[str] = None
@@ -554,6 +567,77 @@ class TPUTrainer(BaseRLTrainer):
             train_params, opt_state = pin(train_params, opt_state)
             return train_params, opt_state, mean_stats
 
+        if self._sentinel is not None:
+            # In-jit gradient guard (sentinel layer 1): the global grad
+            # norm is computed inside the compiled step and a non-finite
+            # (or over-threshold) step is masked with jnp.where — params
+            # and opt state pass through unchanged, with no recompile and
+            # no host round trip. `lr_scale` is a traced weak-typed scalar
+            # (cooldown damping after a rewind), so changing its value
+            # never retraces; on a clean step with lr_scale=1.0 both
+            # `u * 1.0` and `where(True, new, old)` are bitwise exact, so
+            # sentinel-on-but-clean training matches sentinel-off bit for
+            # bit. The guarded fns replace the plain ones wholesale — with
+            # the flag off the graphs above compile exactly as before.
+            threshold = self.config.train.grad_skip_threshold
+
+            def guarded_update(grads, opt_state, train_params, lr_scale):
+                gnorm = optax.global_norm(grads)
+                ok = jnp.isfinite(gnorm)
+                if threshold is not None:
+                    ok = ok & (gnorm <= threshold)
+                updates, new_opt = optimizer.update(grads, opt_state, train_params)
+                updates = jax.tree_util.tree_map(
+                    lambda u: jnp.where(ok, u * lr_scale, jnp.zeros_like(u)),
+                    masked(updates),
+                )
+                # a skipped step must not advance Adam moments/count either
+                new_opt = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o), new_opt, opt_state
+                )
+                train_params = optax.apply_updates(train_params, updates)
+                guard_stats = {
+                    "grad_global_norm": gnorm,
+                    "skipped_updates": 1.0 - ok.astype(jnp.float32),
+                }
+                return train_params, new_opt, guard_stats
+
+            def train_step(train_params, frozen_params, opt_state, batch, lr_scale):
+                _, stats, grads = grad_fn(train_params, frozen_params, batch)
+                train_params, opt_state, guard_stats = guarded_update(
+                    grads, opt_state, train_params, lr_scale
+                )
+                train_params, opt_state = pin(train_params, opt_state)
+                stats = dict(stats)
+                stats["train"] = guard_stats
+                return train_params, opt_state, stats
+
+            def apply_step(train_params, opt_state, acc_grads, lr_scale):
+                grads = jax.tree_util.tree_map(lambda g: g / self.num_mb, acc_grads)
+                train_params, opt_state, guard_stats = guarded_update(
+                    grads, opt_state, train_params, lr_scale
+                )
+                train_params, opt_state = pin(train_params, opt_state)
+                return train_params, opt_state, guard_stats
+
+            def train_scan(train_params, frozen_params, opt_state, stacked_batches, lr_scale):
+                def body(carry, batch):
+                    train_params, opt_state = carry
+                    _, stats, grads = grad_fn(train_params, frozen_params, batch)
+                    train_params, opt_state, guard_stats = guarded_update(
+                        grads, opt_state, train_params, lr_scale
+                    )
+                    stats = dict(stats)
+                    stats["train"] = guard_stats
+                    return (train_params, opt_state), stats
+
+                (train_params, opt_state), stats = jax.lax.scan(
+                    body, (train_params, opt_state), stacked_batches
+                )
+                mean_stats = jax.tree_util.tree_map(lambda s: s.mean(0), stats)
+                train_params, opt_state = pin(train_params, opt_state)
+                return train_params, opt_state, mean_stats
+
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 2))
         self._train_scan_fn = jax.jit(train_scan, donate_argnums=(0, 2))
         self._accum_fns = (
@@ -575,14 +659,38 @@ class TPUTrainer(BaseRLTrainer):
         self.train_params = jax.device_put(self.train_params, train_sh)
         self.opt_state = jax.device_put(self.opt_state, opt_sh)
 
+    def _sentinel_args(self) -> Tuple:
+        """Extra traced args for the guarded train fns: the cooldown LR
+        scale (a plain Python float — weak-typed, so value changes never
+        retrace and bf16 updates stay bf16). Empty with the sentinel off,
+        so every call site can splat it unconditionally."""
+        if self._sentinel is None:
+            return ()
+        return (float(self._sentinel.lr_scale(self.iter_count)),)
+
+    def _maybe_inject_train_fault(self, minibatch: List[Any]) -> List[Any]:
+        """Apply a scheduled train-side fault (resilience.FaultInjector)
+        to this step's microbatches; no-op without an injector."""
+        if self.fault_injector is None:
+            return minibatch
+        fault = self.fault_injector.train_fault(self.iter_count)
+        if fault is None:
+            return minibatch
+        logger.warning(f"FaultInjector: injecting '{fault}' at step {self.iter_count}")
+        self.fault_injector.maybe_hang(fault)
+        if fault == "hang":
+            return minibatch
+        return [self.fault_injector.poison_batch(mb, fault) for mb in minibatch]
+
     def train_minibatch(self, minibatch: List[Any]) -> Dict[str, float]:
         """One optimizer step over `num_mb` microbatches."""
         if self._train_step_fn is None:
             self._build_steps()
+        minibatch = self._maybe_inject_train_fault(minibatch)
         if len(minibatch) == 1:
             self.train_params, self.opt_state, stats = self._train_step_fn(
                 self.train_params, self.frozen_params, self.opt_state,
-                self.batch_to_device(minibatch[0]),
+                self.batch_to_device(minibatch[0]), *self._sentinel_args(),
             )
             self._normalize_state_shardings()
             return stats
@@ -592,11 +700,21 @@ class TPUTrainer(BaseRLTrainer):
         for mb in minibatch:
             acc, stats = accum(self.train_params, self.frozen_params, acc, self.batch_to_device(mb))
             stats_list.append(stats)
-        self.train_params, self.opt_state = apply(self.train_params, self.opt_state, acc)
+        guard_stats = None
+        if self._sentinel is not None:
+            self.train_params, self.opt_state, guard_stats = apply(
+                self.train_params, self.opt_state, acc, *self._sentinel_args()
+            )
+        else:
+            self.train_params, self.opt_state = apply(self.train_params, self.opt_state, acc)
         self._normalize_state_shardings()
         # average stats across microbatches (reference
         # accelerate_base_trainer.py:580-583)
-        return jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *stats_list)
+        stats = jax.tree_util.tree_map(lambda *xs: sum(xs) / len(xs), *stats_list)
+        if guard_stats is not None:
+            stats = dict(stats)
+            stats["train"] = guard_stats
+        return stats
 
     def train_inner_epoch_fused(self, train_dataloader) -> Tuple[Dict[str, float], int]:
         """Run one inner epoch's optimizer steps as a single jitted
@@ -641,13 +759,14 @@ class TPUTrainer(BaseRLTrainer):
                 stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *run)
                 stacked = self.runtime.shard_batch_stacked(stacked)
                 self.train_params, self.opt_state, stats = self._train_scan_fn(
-                    self.train_params, self.frozen_params, self.opt_state, stacked
+                    self.train_params, self.frozen_params, self.opt_state, stacked,
+                    *self._sentinel_args(),
                 )
                 all_stats.append((stats, len(run)))
             else:
                 self.train_params, self.opt_state, stats = self._train_step_fn(
                     self.train_params, self.frozen_params, self.opt_state,
-                    self.batch_to_device(run[0]),
+                    self.batch_to_device(run[0]), *self._sentinel_args(),
                 )
                 all_stats.append((stats, 1))
         self._normalize_state_shardings()
@@ -722,9 +841,22 @@ class TPUTrainer(BaseRLTrainer):
         if self.config.train.handle_preemption:
             guard = resilience.PreemptionGuard().install()
         self._preemption_guard = guard
+        if self.config.train.step_timeout_s:
+            # hang watchdog (sentinel layer 4): beats arrive at step
+            # boundaries and per rollout chunk; a wedged step dumps all
+            # thread stacks and exits 75 so auto_resume takes over
+            self._watchdog = StepWatchdog(
+                self.config.train.step_timeout_s, on_timeout=self._watchdog_on_timeout
+            ).start()
 
         try:
-            return self._learn_loop(self._best_reward, clock)
+            while True:
+                try:
+                    return self._learn_loop(self._best_reward, clock)
+                except SentinelRewind as e:
+                    # sentinel layer 3: restore the pinned last_good
+                    # checkpoint and continue past the offending chunk
+                    self._sentinel_rewind(e)
         except resilience.PreemptionInterrupt as e:
             logger.warning(
                 f"Preempted (signal {e.signum}); emergency checkpoint at "
@@ -737,6 +869,9 @@ class TPUTrainer(BaseRLTrainer):
             if guard is not None:
                 guard.uninstall()
             self._preemption_guard = None
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
             if getattr(self, "_profiling", False):
                 jax.profiler.stop_trace()
                 self._profiling = False
@@ -812,6 +947,10 @@ class TPUTrainer(BaseRLTrainer):
                 for _ in range(self.n_inner_epochs):
                     self.post_backward_callback()
                 self.post_epoch_callback()
+                # fuse_all: the epoch already completed in one dispatch and
+                # the next one collects fresh experience anyway — a pending
+                # skip-chunk request is thereby satisfied
+                self._sentinel_skip_chunk = False
                 continue
             inner_start = pos["inner"] if pos and epoch_idx == start_epoch else 0
             for inner_idx in range(inner_start, self.n_inner_epochs):
@@ -847,6 +986,11 @@ class TPUTrainer(BaseRLTrainer):
                     if done:
                         return results
                     self.post_backward_callback()
+                    if self._sentinel_skip_chunk:
+                        # sentinel skip-chunk: drop the remaining inner
+                        # epochs and collect fresh experience
+                        self._sentinel_skip_chunk = False
+                        break
                     continue
                 if fuse and skip_steps:
                     logger.warning(
@@ -866,8 +1010,20 @@ class TPUTrainer(BaseRLTrainer):
                     results = res or results
                     if done:
                         return results
+                    if self._sentinel_skip_chunk:
+                        break
 
                 self.post_backward_callback()
+                if self._sentinel_skip_chunk:
+                    # sentinel skip-chunk (escalation rung 2): abandon the
+                    # remaining epochs over this suspect batch and collect
+                    # fresh experience via post_epoch_callback
+                    self._sentinel_skip_chunk = False
+                    logger.warning(
+                        f"Sentinel: skipping the rest of the current chunk at "
+                        f"step {self.iter_count}; collecting fresh experience"
+                    )
+                    break
             self.post_epoch_callback()
         return results
 
@@ -889,7 +1045,41 @@ class TPUTrainer(BaseRLTrainer):
         # overwrites the last good checkpoint
         stats = jax.device_get(_flatten_stats(stats))
         stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
-        self._check_divergence(stats)
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        verdict = None
+        if self._sentinel is not None:
+            # the in-jit guard reports the fraction of skipped steps; turn
+            # it back into a count for the cumulative counter
+            self._sentinel.record_skipped(
+                stats.get("train/skipped_updates", 0.0) * n_steps
+            )
+            verdict = self._sentinel.observe_step(stats, self.iter_count)
+            stats.update(self._sentinel.stats())
+            if verdict.action != "ok":
+                logger.warning(
+                    f"Sentinel {verdict.action} at step {self.iter_count}: "
+                    + "; ".join(verdict.reasons)
+                )
+            if verdict.action == "skip":
+                self._sentinel_skip_chunk = True
+            elif verdict.action == "rewind":
+                # flush this step's stats first so the post-mortem trail
+                # includes the anomaly that triggered the rewind
+                self.tracker.log(stats, step=self.iter_count)
+                raise SentinelRewind(self.iter_count, verdict.reasons)
+            elif verdict.action == "abort":
+                self.tracker.log(stats, step=self.iter_count)
+                raise FloatingPointError(
+                    f"Health sentinel abort at step {self.iter_count}: "
+                    + "; ".join(verdict.reasons)
+                    + f". Resume from a checkpoint under "
+                    f"'{self.config.train.checkpoint_dir}' with a lower "
+                    "learning rate or tighter clipping "
+                    "(train.resume_from_checkpoint)."
+                )
+        else:
+            self._check_divergence(stats)
 
         guard = self._preemption_guard
         if guard is not None and guard.triggered:
@@ -910,6 +1100,19 @@ class TPUTrainer(BaseRLTrainer):
                 resilience.gc_checkpoints(
                     self.config.train.checkpoint_dir, self.config.train.checkpoint_keep_n
                 )
+        if (
+            self._sentinel is not None
+            and verdict is not None
+            and verdict.action == "ok"
+            and self._sentinel.should_pin(self.iter_count)
+        ):
+            # pin last_good (the rewind target) only after enough
+            # consecutive clean steps; note_pinned BEFORE save so the
+            # pin's own extra_state carries the pointer
+            directory = os.path.join(self.config.train.checkpoint_dir, LAST_GOOD_NAME)
+            self._sentinel.note_pinned(directory, self.iter_count)
+            logger.info(f"Sentinel: pinning last_good checkpoint at step {self.iter_count}")
+            self.save(directory)
         stats["time/step"] = clock.tick(self.config.train.batch_size * n_steps) / n_steps
         stats["learning_rate"] = float(np.asarray(self.lr_schedule(self.iter_count)))
 
@@ -952,9 +1155,11 @@ class TPUTrainer(BaseRLTrainer):
         return results, best_reward, done
 
     def _check_divergence(self, stats: Dict[str, Any]):
-        """Failure detection (the reference has none, SURVEY.md §5.3):
-        count consecutive steps with non-finite losses; abort with the
-        last-good-checkpoint pointer once patience runs out."""
+        """Legacy failure detection, active when train.sentinel is off
+        (with it on, HealthSentinel subsumes this as one rung of its
+        escalation ladder): count consecutive steps with non-finite
+        losses; abort with the last-good-checkpoint pointer once patience
+        runs out."""
         if not self.config.train.nan_guard:
             return
         bad = any(
@@ -964,18 +1169,52 @@ class TPUTrainer(BaseRLTrainer):
         if not bad:
             self._nan_streak = 0
             return
-        self._nan_streak = getattr(self, "_nan_streak", 0) + 1
+        self._nan_streak += 1
         logger.warning(
             f"Non-finite loss at step {self.iter_count} "
             f"({self._nan_streak}/{self.config.train.nan_guard_patience})"
         )
         if self._nan_streak >= self.config.train.nan_guard_patience:
+            # flush the fatal step's stats first — without this the
+            # diverged step never reaches the tracker and post-mortems
+            # are missing exactly the data point that killed the run
+            self.tracker.log(stats, step=self.iter_count)
             raise FloatingPointError(
                 f"Loss diverged (non-finite for {self._nan_streak} consecutive "
                 f"steps). Resume from the last checkpoint under "
                 f"'{self.config.train.checkpoint_dir}' with a lower learning "
                 "rate or tighter clipping (train.resume_from_checkpoint)."
             )
+
+    def _sentinel_rewind(self, e: SentinelRewind):
+        """Sentinel layer 3: restore the pinned last_good checkpoint
+        bit-exactly, carry the sentinel's own ladder state ACROSS the
+        restore (the rewind budget must survive — reloading it from the
+        pin would reset it and loop forever), advance the PRNG past the
+        offending chunk so the same rollouts are not replayed, and open
+        the cooldown window (LR damp / KL boost)."""
+        sen = self._sentinel
+        assert sen is not None and sen.last_good is not None
+        path = sen.last_good["path"]
+        logger.warning(
+            f"Sentinel rewind #{sen.rewinds_used + 1}/{sen.max_rewinds}: "
+            f"restoring last_good (step {sen.last_good['step']}) from "
+            f"{path} after: " + "; ".join(e.reasons)
+        )
+        ladder_state = sen.state_dict()
+        self.load(path)  # restores params/opt_state/PRNG/loop-pos bit-exactly
+        sen.load_state_dict(ladder_state)
+        sen.note_rewind(self.iter_count)
+        # diverge the PRNG stream from the pinned one: the chunk that bred
+        # the anomaly must not be regenerated verbatim
+        self.rng = jax.random.fold_in(self.rng, np.uint32(e.step))
+        self._sentinel_skip_chunk = False
+        self._post_rewind()
+
+    def _post_rewind(self):
+        """Trainer-specific cleanup after a sentinel rewind (the PPO
+        trainer drops the restored rollout store and collects fresh
+        experience under the post-rewind PRNG/cooldown)."""
 
     def _maybe_profile_step(self):
         """Capture a jax.profiler trace over the configured step window
@@ -1118,11 +1357,17 @@ class TPUTrainer(BaseRLTrainer):
 
     def _extra_resume_state(self) -> Dict[str, Any]:
         """Trainer-specific host state to include in checkpoints (e.g. the
-        PPO rollout store and KL controller). Must be picklable."""
-        return {}
+        PPO rollout store and KL controller). Must be picklable.
+        Subclasses extend the dict returned by super()."""
+        extra: Dict[str, Any] = {}
+        if self._sentinel is not None:
+            extra["sentinel"] = self._sentinel.state_dict()
+        return extra
 
     def _load_extra_resume_state(self, state: Dict[str, Any]) -> None:
         """Inverse of _extra_resume_state."""
+        if self._sentinel is not None and "sentinel" in state:
+            self._sentinel.load_state_dict(state["sentinel"])
 
     def _resume_state_dict(self) -> Dict[str, Any]:
         """Host-side trainer state beyond the param/optimizer trees: the

@@ -573,6 +573,14 @@ class PPOTrainer(TPUTrainer):
         pending = _dispatch_next()
 
         while len(ppo_rl_elements) < num_rollouts:
+            if self._watchdog is not None:
+                # rollout chunks are legitimate long gaps between step
+                # boundaries — each one is a heartbeat
+                self._watchdog.beat()
+            if pending is None:
+                # the quarantine pass can drop rows and under-fill the
+                # prefetch prediction below: dispatch another chunk
+                pending = _dispatch_next()
             stats: Dict[str, float] = {}
             batch, out = pending
             pending = None
@@ -644,10 +652,25 @@ class PPOTrainer(TPUTrainer):
                     stats["fleet/behavior_logprob_rows"] = 0.0
                     stats["fleet/degraded_chunks"] = 1.0
 
-            ppo_rl_elements.extend(self._chunk_to_elements(
+            elements = self._chunk_to_elements(
                 prompt_tensors, sample_outputs, outputs, scores, scores_mask,
                 logprobs, values, log_ratio, h_cache,
-            ))
+            )
+            if self._sentinel is not None:
+                # rollout quarantine + anomaly observation. Element-level
+                # (post-scorer) so dropping rows never changes the jitted
+                # score fn's shapes; stats keys are set on EVERY chunk
+                # (the final averaging iterates the last chunk's keys).
+                elements, n_dropped = self._quarantine_elements(
+                    elements, scores, scores_mask, outputs
+                )
+                stats["sentinel/quarantined_rows"] = float(n_dropped)
+                stats["rollout/entropy"] = (
+                    float(np.mean([-np.mean(e.logprobs) for e in elements]))
+                    if elements else 0.0
+                )
+                self._sentinel.observe_rollout(stats)
+            ppo_rl_elements.extend(elements)
 
             stats["time/rollout_time"] = clock.tick()
             stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
@@ -813,7 +836,12 @@ class PPOTrainer(TPUTrainer):
         parity test ties them together."""
         pad_id = self.tokenizer.pad_token_id
         start = 0 if self.seq2seq else prompt_tensors.shape[1] - 1
-        kl_penalty = -self.kl_ctl.value * log_ratio
+        kl_coef = self.kl_ctl.value
+        if self._sentinel is not None:
+            # post-rewind cooldown: temporarily strengthen the pull toward
+            # the reference policy (train.sentinel_kl_boost; 1.0 = off)
+            kl_coef *= self._sentinel.kl_scale(self.iter_count)
+        kl_penalty = -kl_coef * log_ratio
 
         elements = []
         for ix in range(len(sample_outputs)):
@@ -854,6 +882,21 @@ class PPOTrainer(TPUTrainer):
             )
         return elements
 
+    def _quarantine_elements(self, elements, scores, scores_mask, outputs):
+        """Sentinel rollout quarantine: drop reward-outlier and degenerate
+        (length-collapse / repetition) rows from one chunk's elements
+        before they enter the PPO store. Returns (kept, n_dropped)."""
+        from trlx_tpu.sentinel import repetition_frac
+
+        sample_scores = (np.where(scores_mask, scores, 0.0)).sum(axis=1)
+        resp_lens = np.array([len(o) for o in outputs], dtype=np.int32)
+        rep_fracs = np.array([repetition_frac(o) for o in outputs], dtype=np.float64)
+        drop = self._sentinel.quarantine_mask(sample_scores, resp_lens, rep_fracs)
+        if not drop.any():
+            return elements, 0
+        kept = [e for e, d in zip(elements, drop) if not d]
+        return kept, int(drop.sum())
+
     def add_prompt_pipeline(self, pipeline):
         loader = pipeline.create_loader(self.config.method.chunk_size, shuffle=True)
         self.prompt_iterator = infinite_dataloader(loader)
@@ -864,11 +907,21 @@ class PPOTrainer(TPUTrainer):
         self.store.clear_history()
         self.make_experience(self.config.method.num_rollouts, self.iter_count)
 
+    def _post_rewind(self):
+        """After a sentinel rewind the restored rollout store is the one
+        whose successors bred the anomaly; drop it and collect fresh
+        experience under the post-rewind PRNG stream and cooldown
+        coefficients (damped LR / boosted KL)."""
+        self.store.clear_history()
+        self.make_experience(self.config.method.num_rollouts, self.iter_count)
+
     def _extra_resume_state(self):
         """PPO host state for exact resume: the in-flight rollout store
         (regenerating it would consume PRNG splits the interrupted run
-        never drew), the KL controller, and the reward running moments."""
-        return {
+        never drew), the KL controller, and the reward running moments —
+        composed with the base trainer's state (sentinel ladder)."""
+        extra = super()._extra_resume_state()
+        extra.update({
             "store_history": list(self.store.history),
             "kl_ctl_value": float(self.kl_ctl.value),
             "mean_kl": float(self.mean_kl),
@@ -878,9 +931,11 @@ class PPOTrainer(TPUTrainer):
                 "var": self.running_moments.var,
                 "count": self.running_moments.count,
             },
-        }
+        })
+        return extra
 
     def _load_extra_resume_state(self, state):
+        super()._load_extra_resume_state(state)
         if "store_history" in state:
             self.store.clear_history()
             self.store.push(state["store_history"])
@@ -1049,7 +1104,8 @@ class PPOTrainer(TPUTrainer):
         ).reshape(n_epochs * steps, bs)
         stacked = jax.tree_util.tree_map(lambda a: a[jnp.asarray(idx)], chunk)
         self.train_params, self.opt_state, stats = self._train_scan_fn(
-            self.train_params, self.frozen_params, self.opt_state, stacked
+            self.train_params, self.frozen_params, self.opt_state, stacked,
+            *self._sentinel_args(),
         )
         self._normalize_state_shardings()
         # advance like learn() does per optimizer step — the next cycle's

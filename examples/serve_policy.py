@@ -18,6 +18,16 @@ docs/serving.md):
     python examples/serve_policy.py '{"checkpoint": "random:gpt2-tiny",
                                       "replicas": 3}'
 
+    # the same fleet under lifecycle supervision: crashed replicas
+    # respawn with backoff, crash-loopers quarantine, and new
+    # manifest-complete checkpoints in watch_dir roll through one
+    # replica at a time (capacity never drops below N-1); Prometheus
+    # fleet metrics on metrics_port (docs/robustness.md)
+    python examples/serve_policy.py '{"checkpoint": "random:gpt2-tiny",
+                                      "replicas": 3, "supervised": true,
+                                      "spares": 1, "watch_dir": "ckpts",
+                                      "metrics_port": 8700}'
+
     # then, from anywhere:
     curl -s localhost:8600/generate -d '{"prompt": "hello", "max_new_tokens": 32}'
     curl -s localhost:8600/healthz
@@ -48,13 +58,19 @@ def main(hparams=None):
     watch_dir = hparams.pop("watch_dir", None)
     background = hparams.pop("background", False)  # tests set this
     replicas = int(hparams.pop("replicas", 1))
+    supervised = bool(hparams.pop("supervised", False))
+    spares = int(hparams.pop("spares", 0))
+    metrics_port = hparams.pop("metrics_port", None)
+    supervisor_kwargs = dict(hparams.pop("supervisor_kwargs", None) or {})
 
     config = default_sft_config().evolve(
         model=dict(model_path=checkpoint),
         tokenizer=dict(tokenizer_path=tokenizer),
         train=dict(total_steps=0, tracker=None,
                    checkpoint_dir=os.path.join("/tmp", "_serve_ckpt")),
-        inference=dict(port=port, watch_dir=watch_dir),
+        # under supervision the replicas must NOT self-watch the dir:
+        # the supervisor owns reloads (rolling, one replica at a time)
+        inference=dict(port=port, watch_dir=None if supervised else watch_dir),
     )
     if hparams:
         config = TRLConfig.update(config, hparams)
@@ -64,6 +80,43 @@ def main(hparams=None):
     trainer = SFTTrainer(config)
     if resume:
         trainer.load(resume)
+
+    if supervised:
+        # thread replicas under a FleetSupervisor: self-healing fleet in
+        # one process. The printed snippet points the trainer at the
+        # supervisor-owned replicas via rollout_fleet_urls; trainers that
+        # want the supervision *inside* the training process use
+        # train.rollout_fleet_supervised instead (docs/serving.md)
+        from trlx_tpu.inference.supervisor import FleetSupervisor, ThreadReplica
+
+        def factory(seat_index):
+            return ThreadReplica(lambda: trainer.serve(port=0, background=True))
+
+        supervisor = FleetSupervisor(
+            factory,
+            num_replicas=replicas,
+            spares=spares,
+            watch_dir=watch_dir,
+            metrics_port=None if metrics_port is None else int(metrics_port),
+            **supervisor_kwargs,
+        ).start()
+        supervisor.wait_ready(timeout_s=supervisor.start_timeout_s)
+        urls = [s.url for s in supervisor.seats if s.role == "active" and s.url]
+        print(f"Supervising {replicas} replicas (+{spares} spares): "
+              + ", ".join(urls))
+        if metrics_port is not None:
+            print(f"Fleet metrics: http://127.0.0.1:{supervisor.metrics_port}/metrics")
+        print("Trainer config for these replicas (TRLConfig.evolve / hparams):")
+        print(json.dumps({"train": {"rollout_backend": "fleet",
+                                    "rollout_fleet_urls": urls}}, indent=2))
+        if background:
+            return supervisor
+        try:
+            while True:
+                supervisor._thread.join(3600)
+        except KeyboardInterrupt:
+            supervisor.stop()
+        return supervisor
 
     if replicas > 1:
         # one process, N independent server replicas (engine + scheduler

@@ -1,0 +1,144 @@
+"""CI smoke: a short CPU PPO run generating through a trainer-launched
+SUPERVISED rollout fleet (train.rollout_fleet_supervised) with chaos
+injected mid-run — one healthy replica is killed under load, and one
+seat is crash-looped via FaultInjector.crash_loop_replicas. Passes when
+the 2-cycle run completes WITHOUT human intervention: no chunk degraded
+to local generation (the fleet served every rollout), the killed replica
+respawned back to capacity, the crash-looper was quarantined after
+spending its flap budget, and the final loss is finite.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/fleet_supervisor_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from trlx_tpu import resilience  # noqa: E402
+from trlx_tpu.data.default_configs import default_ppo_config  # noqa: E402
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline  # noqa: E402
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402
+from trlx_tpu.utils import set_seed  # noqa: E402
+
+FLEET_SIZE = 3
+CRASH_SEAT = 2  # this seat dies ~0.2s after every spawn -> quarantine
+MAX_NEW = 4
+
+
+def build_config(workdir: str):
+    return default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(
+            seq_length=32, batch_size=4, epochs=2, total_steps=2,
+            checkpoint_interval=100, eval_interval=100,
+            tracker="jsonl",
+            logging_dir=os.path.join(workdir, "logs"),
+            checkpoint_dir=os.path.join(workdir, "ckpts"),
+            seed=7,
+            rollout_backend="fleet",
+            rollout_fleet_supervised=True,
+            rollout_fleet_size=FLEET_SIZE,
+            rollout_fleet_kwargs=dict(replica_retries=1, hedge=False),
+            rollout_fleet_supervisor_kwargs=dict(
+                tick_s=0.02, probe_interval_s=0.1, unhealthy_after=2,
+                respawn_backoff_s=0.2, respawn_backoff_max_s=1.0,
+                flap_window_s=60.0, flap_budget=2,
+                sync_interval_s=3600.0, start_timeout_s=300.0,
+            ),
+        ),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(max_new_tokens=MAX_NEW, do_sample=False)),
+        inference=dict(num_slots=4, max_prompt_len=32, max_new_tokens=MAX_NEW,
+                       max_wait_s=0.0),
+    )
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="fleet_supervisor_")
+    config = build_config(workdir)
+    set_seed(config.train.seed)
+
+    state = {"killed": False}
+    snapshots = []
+
+    def reward_fn(samples, **kw):
+        # chaos hook: after the first chunk's rollouts, take down a
+        # healthy non-crash-loop replica while the run is live
+        sup = trainer._rollout_supervisor
+        if sup is not None and not state["killed"]:
+            state["killed"] = True
+            for seat in sup.seats:
+                if seat.index != CRASH_SEAT and seat.state == "serving":
+                    seat.handle.server.shutdown()
+                    print(f"[chaos] killed replica seat {seat.index} ({seat.url})")
+                    break
+        if sup is not None:
+            snapshots.append({k: v for k, v in sup.stats().items()
+                              if isinstance(v, (int, float))})
+        return [float(len(s)) for s in samples]
+
+    trainer = PPOTrainer(config, reward_fn=reward_fn)
+    trainer.fault_injector = resilience.FaultInjector(
+        crash_loop_replicas=[CRASH_SEAT], crash_loop_after_s=0.2
+    )
+    prompts = ["hello world", "jax tpu", "ppo", "fleet"] * 2
+    max_prompt_length = config.train.seq_length - MAX_NEW
+    trainer.add_prompt_pipeline(
+        PromptPipeline(prompts, max_prompt_length, trainer.tokenizer)
+    )
+    trainer.add_eval_pipeline(
+        PromptPipeline(prompts, max_prompt_length, trainer.tokenizer)
+    )
+    trainer.learn()
+
+    rows = []
+    for name in os.listdir(config.train.logging_dir):
+        if name.endswith(".metrics.jsonl"):
+            with open(os.path.join(config.train.logging_dir, name)) as f:
+                rows += [json.loads(line) for line in f if line.strip()]
+    fleet_rows = [r for r in rows if "fleet/respawns" in r]
+    final_fleet = fleet_rows[-1]
+    final_loss = [r for r in rows if "losses/total_loss" in r][-1]["losses/total_loss"]
+
+    assert trainer.iter_count == config.train.total_steps, (
+        f"run stopped at step {trainer.iter_count} / {config.train.total_steps}"
+    )
+    assert trainer._rollout_supervisor is None, "fleet outlived learn()"
+    degraded = sum(r.get("fleet/degraded_chunks", 0.0) for r in rows)
+    assert degraded == 0.0, (
+        f"{degraded:.0f} chunk(s) degraded to local generation (dropped fleet "
+        "rollouts)"
+    )
+    assert final_fleet["fleet/quarantines"] >= 1, "crash-looper never quarantined"
+    # the quarantined seat stopped respawning; the killed seat came back:
+    # every non-quarantined seat is serving again
+    want_capacity = FLEET_SIZE - int(final_fleet["fleet/quarantines"])
+    final_capacity = snapshots[-1]["capacity"]
+    assert final_capacity == want_capacity, (
+        f"fleet did not respawn to capacity: {final_capacity} vs {want_capacity}"
+    )
+    assert final_fleet["fleet/respawns"] >= FLEET_SIZE + 2, (
+        "expected respawns beyond the initial boots (kill + crash loop)"
+    )
+    assert final_fleet["fleet/deaths"] >= 2, "chaos deaths not observed"
+    assert np.isfinite(final_loss), f"non-finite final loss: {final_loss}"
+    print(
+        f"fleet supervisor smoke OK: {config.train.total_steps} cycles, "
+        f"capacity {final_capacity:.0f}/{FLEET_SIZE} "
+        f"({final_fleet['fleet/quarantines']:.0f} quarantined), "
+        f"{final_fleet['fleet/respawns']:.0f} spawns, "
+        f"{final_fleet['fleet/deaths']:.0f} deaths, 0 degraded chunks, "
+        f"final loss {final_loss:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

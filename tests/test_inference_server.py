@@ -242,3 +242,94 @@ def test_hot_reload_from_checkpoint(trainer, tmp_path):
         assert health["reloads"] == 1 and health["checkpoint_step"] == 7
     finally:
         server.shutdown()
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_admin_drain_undrain_reload(trainer, tmp_path):
+    """The supervisor's control surface: POST /admin/drain flips the
+    scheduler to reject-new (503 DrainingError to new generates, not-ready
+    healthz), /admin/undrain restores service, and /admin/reload swaps an
+    explicit manifest-complete checkpoint even on a server with no
+    watch_dir of its own."""
+    import urllib.error
+
+    from trlx_tpu import resilience
+
+    server = make_server(trainer, num_slots=1, max_new=4)
+    url = server.start_background()
+    try:
+        out = _post(url + "/admin/drain", {"wait_s": 5})
+        assert out["draining"] is True and out["idle"] is True
+        health = json.loads(_get(url + "/healthz"))
+        assert health["draining"] is True and health["ready"] is False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(url + "/generate", {"prompt_ids": [1, 2, 3]})
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+
+        out = _post(url + "/admin/undrain", {})
+        assert out["draining"] is False
+        res = remote_generate(url)([1, 2, 3], max_new_tokens=4)
+        assert res["finish_reason"] in ("eos", "length")
+
+        # explicit reload: no watch_dir, path comes from the caller
+        ckpt = tmp_path / "checkpoint_11"
+        trainer.iter_count = 11
+        trainer.save(str(ckpt))
+        out = _post(url + "/admin/reload", {"path": str(ckpt)}, timeout=120)
+        assert out["reloaded"] is True and out["checkpoint_step"] == 11
+        health = json.loads(_get(url + "/healthz"))
+        assert health["checkpoint_step"] == 11
+        # truncated checkpoint: refused, current weights stay live
+        bad = tmp_path / "checkpoint_13"
+        trainer.iter_count = 13
+        trainer.save(str(bad))
+        resilience.FaultInjector.truncate_checkpoint(str(bad))
+        out = _post(url + "/admin/reload", {"path": str(bad)}, timeout=120)
+        assert out["reloaded"] is False
+        assert json.loads(_get(url + "/healthz"))["checkpoint_step"] == 11
+    finally:
+        server.shutdown()
+
+
+def test_graceful_shutdown_drains_before_close(trainer):
+    """shutdown(drain_s=...) finishes in-flight decodes before the HTTP
+    listener goes away: a request racing the shutdown either completes
+    successfully or is refused with a clean 503 over a live connection —
+    never a torn socket (connection reset / refused)."""
+    server = make_server(trainer, num_slots=1, max_new=8)
+    url = server.start_background()
+    outcomes = []
+
+    def client():
+        try:
+            res = remote_generate(url, retries=0)([4] * 30, max_new_tokens=8)
+            outcomes.append(("ok", res["finish_reason"]))
+        except Exception as e:
+            outcomes.append(("refused", repr(e)))
+
+    t = threading.Thread(target=client)
+    t.start()
+    import time
+
+    time.sleep(0.05)  # let the request reach the server
+    server.shutdown(drain_s=60.0)
+    t.join(timeout=120)
+    assert outcomes, "client never finished"
+    kind, detail = outcomes[0]
+    if kind == "ok":
+        assert detail in ("eos", "length")
+    else:
+        # the listener answered while draining: an HTTP 503, not a
+        # connection-level failure
+        assert "503" in detail, f"torn connection during drain: {detail}"
+    # after the drain the scheduler is stopped and the port is closed
+    assert server._httpd is None

@@ -1,0 +1,479 @@
+"""Fleet supervisor tests (trlx_tpu/inference/supervisor.py).
+
+The lifecycle state machine — spawn/watch/respawn with backoff, hung
+replica detection, crash-loop quarantine, warm-spare promotion, rolling
+weight sync with the >= N-1 capacity invariant — runs against *fake*
+HTTP replicas (a /healthz + /admin/reload stub with controllable
+behavior), so the whole matrix is exercised in seconds without JAX.
+One integration test at the bottom drives the real thing: a PPO trainer
+launching its own supervised in-process fleet
+(train.rollout_fleet_supervised), losing a replica between rollout
+collections, and recovering to full capacity with exact rollout counts.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.inference.supervisor import (
+    QUARANTINED,
+    SERVING,
+    FleetSupervisor,
+    ReplicaHandle,
+    ThreadReplica,
+)
+
+# ----------------------------------------------------------------------
+# Fake replica: /healthz + /admin/reload without an engine
+# ----------------------------------------------------------------------
+
+
+class _FakeReplicaServer:
+    """HTTP stand-in for an InferenceServer: /healthz answers ready +
+    checkpoint_step, POST /admin/reload adopts the manifest's step (or
+    500s when `reload_ok` is off), and `healthz_delay_s` wedges the
+    health endpoint to simulate a hung replica."""
+
+    def __init__(self, ready=True, step=None, reload_ok=True, healthz_delay_s=0.0):
+        self.ready = ready
+        self.step = step
+        self.reload_ok = reload_ok
+        self.healthz_delay_s = healthz_delay_s
+        srv = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") == "/healthz":
+                    if srv.healthz_delay_s:
+                        time.sleep(srv.healthz_delay_s)
+                    self._json(200, {"status": "ok" if srv.ready else "degraded",
+                                     "ready": srv.ready,
+                                     "checkpoint_step": srv.step})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path.rstrip("/") == "/admin/reload":
+                    if not srv.reload_ok:
+                        self._json(500, {"error": "reload refused"})
+                        return
+                    manifest = resilience.read_manifest(payload["path"])
+                    srv.step = int(manifest["step"])
+                    self._json(200, {"reloaded": True, "checkpoint_step": srv.step})
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None  # ThreadReplica.alive goes False
+
+
+def _fake_factory(overrides=None):
+    """factory(seat_index) -> ThreadReplica over a fresh _FakeReplicaServer;
+    `overrides` maps seat index -> _FakeReplicaServer kwargs."""
+    overrides = overrides or {}
+
+    def factory(i):
+        return ThreadReplica(lambda: _FakeReplicaServer(**overrides.get(i, {})))
+
+    return factory
+
+
+# fast timings: the state machine is event-driven off these intervals, so
+# the tests bound on them, not on wall-clock generosity
+FAST = dict(
+    tick_s=0.01,
+    probe_interval_s=0.03,
+    probe_timeout_s=0.5,
+    unhealthy_after=2,
+    start_timeout_s=10.0,
+    respawn_backoff_s=0.05,
+    respawn_backoff_max_s=0.5,
+    flap_window_s=10.0,
+    flap_budget=2,
+    sync_interval_s=3600.0,  # sync only when a test calls sync_once()
+    drain_timeout_s=2.0,
+    reload_timeout_s=3.0,
+    router_kwargs=dict(replica_retries=0, hedge=False, probe_timeout_s=1.0),
+)
+
+
+def _make(n=2, spares=0, overrides=None, **kw):
+    opts = {**FAST, **kw}
+    sup = FleetSupervisor(_fake_factory(overrides), num_replicas=n,
+                          spares=spares, **opts)
+    sup.start()
+    return sup
+
+
+def _ckpt(tmp_path, name, step):
+    d = tmp_path / name
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "params.msgpack").write_bytes(b"\x00")
+    resilience.write_manifest(str(d), step)
+    return str(d)
+
+
+def _wait(predicate, timeout_s=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    assert predicate(), f"timed out waiting for {msg}"
+
+
+# ----------------------------------------------------------------------
+# Lifecycle: spawn, respawn, hang detection, quarantine, spares
+# ----------------------------------------------------------------------
+
+
+def test_spawn_to_full_capacity():
+    """N seats spawn, probe ready, and register in the supervisor-built
+    router; stats and events reflect a clean fleet."""
+    sup = _make(n=3)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        assert sup.healthy_active() == 3
+        _wait(lambda: sup.router.capacity() == 3, msg="router capacity 3")
+        stats = sup.stats()
+        assert stats["respawns"] == 3 and stats["deaths"] == 0
+        assert {e["kind"] for e in sup.events} >= {"spawned", "serving"}
+    finally:
+        sup.stop()
+
+
+def test_respawn_after_replica_death():
+    """A killed replica (listener gone -> handle.alive False) is detected,
+    removed from the router, and respawned on a fresh port back to full
+    capacity."""
+    sup = _make(n=2)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        victim = sup.seats[0]
+        old_url = victim.url
+        victim.handle.server.shutdown()
+        _wait(lambda: sup.counters["deaths"] >= 1, msg="death detected")
+        _wait(lambda: sup.healthy_active() == 2, msg="capacity recovered")
+        assert sup.counters["respawns"] >= 3  # 2 boots + >=1 respawn
+        # dead URL is out of the router, the fresh one is in
+        urls = {r.url for r in sup.router.replicas}
+        assert old_url not in urls
+        assert sup.seats[0].url in urls
+        assert any(e["kind"] == "died" for e in sup.events)
+    finally:
+        sup.stop()
+
+
+def test_hung_replica_is_killed_and_respawned():
+    """A replica whose /healthz wedges (process up, endpoint hung) fails
+    `unhealthy_after` probes and is treated as dead — killed and
+    respawned healthy."""
+    sup = _make(n=2)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        victim = sup.seats[1]
+        victim.handle.server.healthz_delay_s = 5.0  # >> probe_timeout_s
+        _wait(lambda: sup.counters["deaths"] >= 1, timeout_s=15.0,
+              msg="hang detected")
+        _wait(lambda: sup.healthy_active() == 2, timeout_s=15.0,
+              msg="capacity recovered")
+        assert "probes" in str(
+            [e for e in sup.events if e["kind"] == "died"][0]["reason"]
+        )
+    finally:
+        sup.stop()
+
+
+def test_crash_loop_quarantine():
+    """FaultInjector.crash_loop_replicas kills seat 1 shortly after every
+    (re)spawn; once deaths exceed the flap budget inside the window the
+    seat is QUARANTINED (no further respawns) and the fleet keeps serving
+    on the survivor."""
+    injector = resilience.FaultInjector(
+        crash_loop_replicas=[1], crash_loop_after_s=0.05
+    )
+    sup = _make(n=2, fault_injector=injector)
+    try:
+        _wait(lambda: sup.counters["quarantines"] == 1, timeout_s=20.0,
+              msg="quarantine")
+        assert sup.seats[1].state == QUARANTINED
+        # budget=2 -> exactly 3 deaths (the 3rd quarantines), no more
+        deaths_at_quarantine = sup.counters["deaths"]
+        assert deaths_at_quarantine == FAST["flap_budget"] + 1
+        respawns = sup.counters["respawns"]
+        time.sleep(0.5)
+        assert sup.counters["respawns"] == respawns  # quarantine is final
+        assert sup.healthy_active() == 1
+        assert sup.seats[0].state == SERVING  # survivor untouched
+    finally:
+        sup.stop()
+
+
+def test_backoff_doubles_then_resets():
+    """Each death doubles the seat's respawn backoff (capped); a seat that
+    then stays healthy a full flap window earns the base backoff back."""
+    sup = _make(n=1, flap_window_s=0.4, flap_budget=50)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        seat = sup.seats[0]
+        base = seat.backoff_s
+        seat.handle.server.shutdown()
+        _wait(lambda: seat.backoff_s > base, msg="backoff doubled")
+        _wait(lambda: sup.healthy_active() == 1, msg="respawned")
+        # flap_window_s of clean serving resets backoff + death history
+        _wait(lambda: seat.backoff_s == base and not seat.death_times,
+              timeout_s=5.0, msg="backoff reset")
+    finally:
+        sup.stop()
+
+
+def test_warm_spare_promotion():
+    """With a warm spare, an active death promotes the spare instantly
+    (registered in the router) instead of waiting out a respawn; the dead
+    seat respawns into the spare pool."""
+    sup = _make(n=2, spares=1)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        _wait(lambda: sup.spares_ready() == 1, msg="spare warm")
+        spare_url = next(s.url for s in sup.seats if s.role == "spare")
+        sup.seats[0].handle.server.shutdown()
+        _wait(lambda: sup.counters["promotions"] == 1, msg="promotion")
+        assert sup.healthy_active() == 2
+        urls = {r.url for r in sup.router.replicas}
+        assert spare_url in urls
+        # the dead seat becomes the new spare and respawns warm
+        assert sup.seats[0].role == "spare"
+        _wait(lambda: sup.spares_ready() == 1, msg="spare pool refilled")
+    finally:
+        sup.stop()
+
+
+# ----------------------------------------------------------------------
+# Rolling weight sync
+# ----------------------------------------------------------------------
+
+
+def test_rolling_sync_updates_every_replica(tmp_path):
+    """A manifest-complete checkpoint rolls through spare-first, one
+    replica at a time; every replica ends on the new step and router
+    capacity never dropped below N-1 (sync_min_capacity)."""
+    sup = _make(n=2, spares=1, watch_dir=str(tmp_path))
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        _wait(lambda: sup.spares_ready() == 1, msg="spare warm")
+        _ckpt(tmp_path, "checkpoint_05", 5)
+        assert sup.sync_once() is True
+        assert sup.synced_step == 5
+        assert all(s.checkpoint_step == 5 for s in sup.seats)
+        assert sup.counters["sync_replicas_synced"] == 3
+        assert sup.counters["sync_min_capacity"] >= 1  # N-1 with N=2
+        # spare reloads before any active (promotion mid-sync must be fresh)
+        order = [e["seat"] for e in sup.events if e["kind"] == "sync_replica"]
+        spare_ix = next(s.index for s in sup.seats if s.role == "spare")
+        assert order[0] == spare_ix
+        # same checkpoint again: no-op
+        assert sup.sync_once() is False
+        # truncated checkpoint: invisible
+        bad = _ckpt(tmp_path, "checkpoint_09", 9)
+        resilience.FaultInjector.truncate_checkpoint(bad)
+        assert sup.sync_once() is False
+        assert sup.synced_step == 5
+    finally:
+        sup.stop()
+
+
+def test_rolling_sync_reload_failure_respawns(tmp_path):
+    """A replica that refuses its reload is declared dead (sync_failures)
+    and respawned; the other replica still syncs and the fleet converges
+    back to full capacity."""
+    sup = _make(n=2, overrides={0: dict(reload_ok=False)},
+                watch_dir=str(tmp_path))
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        _ckpt(tmp_path, "checkpoint_03", 3)
+        assert sup.sync_once() is True
+        assert sup.counters["sync_failures"] == 1
+        assert sup.counters["sync_replicas_synced"] == 1
+        # seat 0 respawns (fresh fake with reload_ok default True)
+        _wait(lambda: sup.healthy_active() == 2, msg="capacity recovered")
+    finally:
+        sup.stop()
+
+
+# ----------------------------------------------------------------------
+# Observability
+# ----------------------------------------------------------------------
+
+
+def test_metrics_endpoint_serves_fleet_view():
+    """GET /metrics on the supervisor's endpoint renders supervisor
+    lifecycle counters + the router's per-replica series in one scrape;
+    /healthz summarizes fleet state as JSON."""
+    sup = _make(n=2, metrics_port=0)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        base = f"http://127.0.0.1:{sup.metrics_port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "trlx_tpu_fleet_supervisor_respawns_total 2" in text
+        assert "trlx_tpu_fleet_supervisor_capacity 2" in text
+        assert "trlx_tpu_fleet_capacity" in text  # router section
+        assert 'trlx_tpu_fleet_replica_up{url="' in text
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and health["capacity"] == 2
+        assert len(health["seats"]) == 2
+    finally:
+        sup.stop()
+
+
+def test_stats_are_trainer_mergeable():
+    """stats() numerics are what lands under fleet/* in trainer logs."""
+    sup = _make(n=1)
+    try:
+        assert sup.wait_ready(timeout_s=10.0)
+        stats = sup.stats()
+        for key in ("respawns", "deaths", "quarantines", "promotions",
+                    "capacity", "spares_ready", "sync_in_progress"):
+            assert isinstance(stats[key], (int, float)), key
+    finally:
+        sup.stop()
+
+
+def test_stop_kills_replicas_and_closes_router():
+    sup = _make(n=2)
+    assert sup.wait_ready(timeout_s=10.0)
+    servers = [s.handle.server for s in sup.seats]
+    sup.stop()
+    assert all(srv._httpd is None for srv in servers)
+    # router pools are shut down: dispatch threads joined or daemonized
+    assert sup.router._requests._shutdown
+
+
+class _NeverSpawns(ReplicaHandle):
+    def spawn(self):
+        raise RuntimeError("no capacity")
+
+    @property
+    def alive(self):
+        return False
+
+    def kill(self):
+        pass
+
+
+def test_spawn_failure_backs_off_not_crashes():
+    """A factory whose spawn raises puts the seat in backoff (with the
+    event recorded) instead of tearing down the supervisor."""
+    sup = FleetSupervisor(lambda i: _NeverSpawns(), num_replicas=1, **FAST)
+    sup.start()
+    try:
+        _wait(lambda: any(e["kind"] == "spawn_failed" for e in sup.events),
+              msg="spawn failure recorded")
+        assert sup.healthy_active() == 0
+    finally:
+        sup.stop()
+
+
+# ----------------------------------------------------------------------
+# Integration: PPO trainer launches + heals its own fleet
+# ----------------------------------------------------------------------
+
+MAX_NEW = 4
+SUPPRESS = [i for i in range(259) if not (32 <= i < 127 or i == 258)]
+PROMPTS = ["hello world", "jax tpu", "ppo", "fleet"] * 2
+
+
+def test_supervised_ppo_fleet_recovers_and_counts_are_exact(tmp_path):
+    """train.rollout_backend='fleet' + rollout_fleet_supervised: the
+    trainer spawns its own 2-replica supervised fleet, collects a full
+    rollout set through it, loses a replica, and the supervisor respawns
+    it back to full capacity before the next collection — both
+    collections land the exact configured rollout count."""
+    from trlx_tpu.data.default_configs import default_ppo_config
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+    config = default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(
+            seq_length=32, batch_size=4, total_steps=4, tracker=None,
+            checkpoint_dir=str(tmp_path), seed=11,
+            rollout_backend="fleet",
+            rollout_fleet_supervised=True,
+            rollout_fleet_size=2,
+            rollout_fleet_kwargs=dict(replica_retries=0, hedge=False),
+            rollout_fleet_supervisor_kwargs=dict(
+                tick_s=0.02, probe_interval_s=0.1, respawn_backoff_s=0.1,
+                flap_window_s=30.0, flap_budget=3, sync_interval_s=3600.0,
+                start_timeout_s=300.0,
+            ),
+        ),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(max_new_tokens=MAX_NEW, do_sample=False,
+                                    suppress_tokens=SUPPRESS)),
+        inference=dict(num_slots=4, max_prompt_len=32, max_new_tokens=MAX_NEW,
+                       max_wait_s=0.0),
+    )
+    trainer = PPOTrainer(
+        config, reward_fn=lambda samples, **kw: [float(len(s)) for s in samples]
+    )
+    trainer.add_prompt_pipeline(
+        PromptPipeline(PROMPTS, max_prompt_length=8, tokenizer=trainer.tokenizer)
+    )
+    try:
+        trainer.make_experience(config.method.num_rollouts)
+        assert len(trainer.store.history) == config.method.num_rollouts
+        sup = trainer._rollout_supervisor
+        assert sup is not None and sup.healthy_active() == 2
+
+        # chaos: take a replica down between collections — the kill must
+        # be NOTICED (deaths counter) before polling for recovery, or the
+        # capacity check passes vacuously on the not-yet-detected corpse
+        seats = list(sup.seats)
+        sup.seats[0].handle.server.shutdown()
+        _wait(lambda: sup.counters["deaths"] >= 1, timeout_s=60.0,
+              msg="replica death detected")
+        _wait(lambda: sup.healthy_active() == 2, timeout_s=120.0,
+              msg="fleet respawned to capacity")
+        assert sup.counters["respawns"] >= 3 and sup.counters["deaths"] >= 1
+
+        trainer.make_experience(config.method.num_rollouts)
+        assert len(trainer.store.history) == 2 * config.method.num_rollouts
+        for e in trainer.store.history:
+            assert len(np.asarray(e.response_tensor)) <= MAX_NEW
+    finally:
+        trainer.shutdown_rollout_fleet()
+        assert trainer._rollout_supervisor is None
+    # teardown killed every replica (no thread servers outlive the trainer)
+    for seat in seats:
+        assert seat.handle is None or not seat.handle.alive

@@ -385,6 +385,22 @@ class TrainConfig:
     rollout_max_staleness_steps: int = 1
     # Extra ReplicaRouter kwargs (timeout, hedge_after_s, concurrency...).
     rollout_fleet_kwargs: Dict[str, Any] = field(default_factory=dict)
+    # Self-healing fleet (trlx_tpu/inference/supervisor.py). With
+    # rollout_backend="fleet" and rollout_fleet_supervised=true the
+    # trainer LAUNCHES its own fleet instead of connecting to
+    # rollout_fleet_urls: a FleetSupervisor spawns
+    # `rollout_fleet_size` in-process replicas (+ optional warm
+    # `rollout_fleet_spares`), watches their health, respawns crashes
+    # with exponential backoff, quarantines crash-loopers, and performs
+    # rolling weight sync from train.checkpoint_dir (drain -> reload ->
+    # re-probe -> undrain, one replica at a time, so serving capacity
+    # never drops below N-1). The fleet is torn down when learn() exits.
+    rollout_fleet_supervised: bool = False
+    rollout_fleet_size: int = 2
+    rollout_fleet_spares: int = 0
+    # Extra FleetSupervisor kwargs (probe_interval_s, flap_budget,
+    # respawn_backoff_s, metrics_port, watch_dir override...).
+    rollout_fleet_supervisor_kwargs: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
@@ -473,7 +489,7 @@ class TRLConfig:
         open_dicts = {
             "kwargs", "gen_kwargs", "gen_experience_kwargs",
             "trainer_kwargs", "model_extra_configs", "peft_config",
-            "rollout_fleet_kwargs",
+            "rollout_fleet_kwargs", "rollout_fleet_supervisor_kwargs",
         }
 
         def _check_keys(base: Dict, upd: Dict, prefix: str = ""):

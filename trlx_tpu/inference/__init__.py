@@ -3,28 +3,46 @@
 - `engine` — continuous-batching `InferenceEngine` over a slot-based
   KV-cache pool (jitted prefill / decode_step);
 - `scheduler` — FIFO admission, max-wait batching, bounded queue with
-  backpressure, per-request deadlines, drain for weight sync;
+  backpressure, per-request deadlines, drain for weight sync,
+  reject-new/finish-inflight draining for graceful shutdown;
 - `server` — HTTP `POST /generate` + `/healthz` (liveness/readiness) +
-  Prometheus `/metrics`, drain-on-sync checkpoint hot-reload;
+  Prometheus `/metrics` + `POST /admin/{drain,undrain,reload}`,
+  drain-on-sync checkpoint hot-reload, SIGTERM drain-then-exit;
 - `client` — `remote_generate` on the shared retry/circuit-breaker stack;
 - `fleet` — `ReplicaRouter` fronting N replicas: health probes, per-replica
   circuit breakers, least-loaded dispatch with failover, hedged requests,
-  bounded-staleness weight sync, whole-fleet-down degradation signal.
+  bounded-staleness weight sync, whole-fleet-down degradation signal;
+- `supervisor` — `FleetSupervisor` owning replica processes: spawn/watch/
+  respawn with backoff, crash-loop quarantine, warm-spare promotion, and
+  rolling weight sync that never drops serving capacity below N-1.
 """
 
 from trlx_tpu.inference.client import remote_generate
 from trlx_tpu.inference.engine import InferenceEngine
 from trlx_tpu.inference.fleet import FleetUnavailableError, Replica, ReplicaRouter
 from trlx_tpu.inference.metrics import InferenceMetrics
-from trlx_tpu.inference.scheduler import InferenceRequest, QueueFullError, Scheduler
+from trlx_tpu.inference.scheduler import (
+    DrainingError,
+    InferenceRequest,
+    QueueFullError,
+    Scheduler,
+)
 from trlx_tpu.inference.server import (
     CheckpointWatcher,
     InferenceServer,
     load_checkpoint_params,
 )
+from trlx_tpu.inference.supervisor import (
+    FleetSupervisor,
+    ReplicaHandle,
+    SubprocessReplica,
+    ThreadReplica,
+)
 
 __all__ = [
     "CheckpointWatcher",
+    "DrainingError",
+    "FleetSupervisor",
     "FleetUnavailableError",
     "InferenceEngine",
     "InferenceMetrics",
@@ -32,8 +50,11 @@ __all__ = [
     "InferenceServer",
     "QueueFullError",
     "Replica",
+    "ReplicaHandle",
     "ReplicaRouter",
     "Scheduler",
+    "SubprocessReplica",
+    "ThreadReplica",
     "load_checkpoint_params",
     "remote_generate",
 ]

@@ -161,21 +161,21 @@ class ReplicaRouter:
         hedge_max_delay_s: float = 5.0,
         _sleep=None,
     ):
-        if not urls:
-            raise ValueError("ReplicaRouter needs at least one replica URL")
-        self.replicas = [
-            Replica(
-                u,
-                timeout=timeout,
-                retries=replica_retries,
-                retry_base_delay=retry_base_delay,
-                retry_max_delay=retry_max_delay,
-                breaker_threshold=breaker_threshold,
-                breaker_recovery=breaker_recovery,
-                _sleep=_sleep,
-            )
-            for u in urls
-        ]
+        # an empty fleet is allowed (a supervisor registers members as
+        # they come up); dispatch against it degrades via
+        # FleetUnavailableError like a whole-fleet outage
+        # kept for add_replica: a promoted spare / respawned replica gets
+        # the same client knobs as the founding members
+        self._replica_kwargs = dict(
+            timeout=timeout,
+            retries=replica_retries,
+            retry_base_delay=retry_base_delay,
+            retry_max_delay=retry_max_delay,
+            breaker_threshold=breaker_threshold,
+            breaker_recovery=breaker_recovery,
+            _sleep=_sleep,
+        )
+        self.replicas = [Replica(u, **self._replica_kwargs) for u in urls]
         self.max_staleness_steps = int(max_staleness_steps)
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -238,7 +238,9 @@ class ReplicaRouter:
         are live AND ready afterwards."""
         now = time.monotonic()
         n_up = 0
-        for rep in self.replicas:
+        with self._lock:  # membership can change under a supervisor
+            replicas = list(self.replicas)
+        for rep in replicas:
             if force or rep.last_probe == 0.0 or now - rep.last_probe >= self.probe_interval_s:
                 self.probe(rep)
             n_up += int(rep.live and rep.ready)
@@ -447,10 +449,46 @@ class ReplicaRouter:
 
     def _by_url(self, url: str) -> Replica:
         url = url.rstrip("/")
-        for rep in self.replicas:
-            if rep.url == url:
-                return rep
+        with self._lock:
+            for rep in self.replicas:
+                if rep.url == url:
+                    return rep
         raise KeyError(f"unknown replica {url}")
+
+    # ------------------------------------------------------------------
+    # Membership (fleet supervisor: respawns + spare promotion)
+    # ------------------------------------------------------------------
+
+    def add_replica(self, url: str) -> Replica:
+        """Register a new serving member (a respawned replica on a fresh
+        port, or a promoted warm spare). Idempotent per URL; the new
+        replica uses the router's founding client knobs and is probed
+        before its first dispatch."""
+        url = url.rstrip("/")
+        with self._lock:
+            for rep in self.replicas:
+                if rep.url == url:
+                    return rep
+            rep = Replica(url, **self._replica_kwargs)
+            rep.last_probe = 0.0  # force a probe before first dispatch
+            self.replicas.append(rep)
+        self.probe(rep)
+        return rep
+
+    def remove_replica(self, url: str) -> None:
+        """Forget a member (a dead/quarantined replica). In-flight
+        requests already posted to it finish on their own; no new
+        dispatch will pick it. Unknown URLs are a no-op."""
+        url = url.rstrip("/")
+        with self._lock:
+            self.replicas = [rep for rep in self.replicas if rep.url != url]
+
+    def capacity(self) -> int:
+        """How many replicas are currently dispatchable (live, ready, not
+        draining, breaker closed, fresh) — the serving capacity a rolling
+        sync must keep at >= N-1."""
+        with self._lock:
+            return sum(int(self._eligible(rep)) for rep in self.replicas)
 
     def drain(self, url: str, timeout_s: float = 30.0) -> bool:
         """Stop dispatching to `url` and wait for its in-flight requests
@@ -475,9 +513,56 @@ class ReplicaRouter:
         """Router counters + per-replica snapshots (for logs/tests)."""
         with self._lock:
             out: Dict[str, Any] = dict(self.counters)
-        out["replicas"] = [rep.snapshot() for rep in self.replicas]
+            replicas = list(self.replicas)
+        out["capacity"] = self.capacity()
+        out["replicas"] = [rep.snapshot() for rep in replicas]
         return out
 
-    def close(self) -> None:
-        self._coordinators.shutdown(wait=False)
-        self._requests.shutdown(wait=False)
+    def render_metrics(self) -> str:
+        """Prometheus text view of the router: lifetime counters plus
+        per-replica gauges (labelled by url), so a fleet is scrapable
+        like a single server. A supervisor's `/metrics` endpoint serves
+        this concatenated with its own lifecycle counters."""
+        ns = "trlx_tpu_fleet"
+        with self._lock:
+            counters = dict(self.counters)
+            replicas = list(self.replicas)
+        lines: List[str] = []
+        for name, value in sorted(counters.items()):
+            lines.append(f"# TYPE {ns}_{name}_total counter")
+            lines.append(f"{ns}_{name}_total {value}")
+        lines.append(f"# TYPE {ns}_capacity gauge")
+        lines.append(f"{ns}_capacity {self.capacity()}")
+        gauges = (
+            ("replica_up", lambda r: int(r.live)),
+            ("replica_ready", lambda r: int(r.ready)),
+            ("replica_draining", lambda r: int(r.draining)),
+            ("replica_breaker_open", lambda r: int(r.breaker.state == "open")),
+            ("replica_inflight", lambda r: r.inflight),
+        )
+        for name, fn in gauges:
+            lines.append(f"# TYPE {ns}_{name} gauge")
+            for rep in replicas:
+                lines.append(f'{ns}_{name}{{url="{rep.url}"}} {fn(rep)}')
+        for name, attr in (("replica_served", "served"),
+                           ("replica_failures", "failures")):
+            lines.append(f"# TYPE {ns}_{name}_total counter")
+            for rep in replicas:
+                lines.append(
+                    f'{ns}_{name}_total{{url="{rep.url}"}} {getattr(rep, attr)}'
+                )
+        return "\n".join(lines) + "\n"
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Tear down the dispatch pools. Pending (not yet started) work
+        is cancelled and worker threads are joined with a bounded
+        timeout, so no hedge/coordinator thread survives to log or touch
+        sockets after a test (or trainer) has moved on. In-flight HTTP
+        posts cannot be aborted; the join waits up to `timeout_s` for
+        them, then gives up rather than blocking teardown forever."""
+        for pool in (self._coordinators, self._requests):
+            pool.shutdown(wait=False, cancel_futures=True)
+        deadline = time.monotonic() + float(timeout_s)
+        for pool in (self._coordinators, self._requests):
+            for t in list(getattr(pool, "_threads", ()) or ()):
+                t.join(timeout=max(0.0, deadline - time.monotonic()))

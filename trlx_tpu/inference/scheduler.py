@@ -42,6 +42,17 @@ class QueueFullError(RuntimeError):
         super().__init__(f"request queue full ({depth} deep)")
 
 
+class DrainingError(RuntimeError):
+    """The scheduler is in reject-new drain mode (graceful shutdown or an
+    orchestrated reload): new submits are refused while already-accepted
+    requests finish. The server maps this to 503 + Retry-After so fleet
+    routers fail the request over to another replica."""
+
+    def __init__(self, retry_after: float = 1.0):
+        self.retry_after = retry_after
+        super().__init__("scheduler is draining (reject-new mode)")
+
+
 @dataclass
 class InferenceRequest:
     id: int
@@ -94,6 +105,7 @@ class Scheduler:
         self._ids = itertools.count()
         self._running = False
         self._paused = False  # admission gate for drain-on-sync
+        self._rejecting = False  # reject-new/finish-inflight shutdown mode
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -130,6 +142,9 @@ class Scheduler:
         with self._cond:
             if not self._running:
                 raise RuntimeError("scheduler is not running")
+            if self._rejecting:
+                self.metrics.inc("requests_rejected_total")
+                raise DrainingError()
             if len(self._queue) >= self.max_queue_depth:
                 self.metrics.inc("requests_rejected_total")
                 # rough drain estimate: one queued generation ahead of us
@@ -163,6 +178,39 @@ class Scheduler:
         with self._cond:
             self._paused = False
             self._cond.notify_all()
+
+    def reject_new(self) -> None:
+        """Enter reject-new/finish-inflight shutdown mode: `submit`
+        raises `DrainingError` while everything already accepted (queued
+        AND in-flight) runs to completion. Unlike `pause_admission`,
+        queued requests keep being admitted into freed slots — this is
+        the graceful-shutdown half of a drain, not the weight-sync one."""
+        with self._cond:
+            self._rejecting = True
+
+    def accept_new(self) -> None:
+        with self._cond:
+            self._rejecting = False
+            self._cond.notify_all()
+
+    @property
+    def accepting(self) -> bool:
+        """False while in reject-new drain mode (healthz readiness off)."""
+        with self._cond:
+            return not self._rejecting
+
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Wait until the queue and every slot are empty (all accepted
+        work delivered). Returns False on timeout. Pair with
+        `reject_new` for a graceful drain-then-exit."""
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._queue and not self._slot_req:
+                    return True
+            time.sleep(0.005)
+        with self._cond:
+            return not self._queue and not self._slot_req
 
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Pause admission and wait until every slot is empty. Returns

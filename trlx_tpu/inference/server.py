@@ -35,7 +35,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from trlx_tpu import resilience
-from trlx_tpu.inference.scheduler import QueueFullError, Scheduler
+from trlx_tpu.inference.scheduler import DrainingError, QueueFullError, Scheduler
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -73,7 +73,7 @@ class CheckpointWatcher(threading.Thread):
     for the whole window, which flips the server's `/healthz` readiness
     off so a fleet router routes around the replica mid-swap."""
 
-    def __init__(self, engine, watch_dir: str, interval_s: float = 5.0,
+    def __init__(self, engine, watch_dir: Optional[str], interval_s: float = 5.0,
                  metrics=None, loader=load_checkpoint_params,
                  scheduler=None, drain_timeout_s: float = 30.0):
         super().__init__(name="trlx-tpu-ckpt-watcher", daemon=True)
@@ -89,41 +89,55 @@ class CheckpointWatcher(threading.Thread):
         self._loaded_key = None  # (path, step, wall_time) of the live params
         self.reloads = 0
         self.reloading = False  # True while a swap is in flight (readiness off)
+        self._reload_lock = threading.Lock()  # poll loop vs /admin/reload
         self._stop = threading.Event()
 
     def poll_once(self) -> bool:
         """One scan; returns True if a new checkpoint was swapped in."""
+        if not self.watch_dir:
+            return False  # admin-reload-only watcher (supervised replicas)
         path = resilience.find_latest_valid_checkpoint(self.watch_dir)
         if path is None:
             return False
-        manifest = resilience.read_manifest(path) or {}
+        return self.load_path(path)
+
+    def load_path(self, path: str) -> bool:
+        """Drain-swap to the manifest-complete checkpoint at `path` (the
+        core of `poll_once`, also driven directly by ``POST
+        /admin/reload`` for supervisor-orchestrated rolling sync).
+        Returns False when `path` is already live or fails to load."""
+        manifest = resilience.read_manifest(path)
+        if manifest is None:
+            logger.warning(f"hot-reload: {path} has no complete manifest; refusing")
+            return False
         step = int(manifest.get("step", -1))
         # key on (path, step, wall_time): a re-promotion into the SAME
         # directory name (atomic dir swap) is still picked up
         key = (path, step, manifest.get("wall_time"))
-        if key == self._loaded_key:
-            return False
-        self.reloading = True
-        try:
-            try:
-                params = self.loader(path)
-            except Exception as e:
-                logger.warning(f"hot-reload: failed to load {path}: {e}")
+        with self._reload_lock:
+            if key == self._loaded_key:
                 return False
-            if self.scheduler is not None:
-                if not self.scheduler.drain(self.drain_timeout_s):
-                    logger.warning(
-                        "hot-reload: drain timed out after "
-                        f"{self.drain_timeout_s}s; swapping with requests in flight"
-                    )
-            self.engine.set_params(params)
-        finally:
-            if self.scheduler is not None:
-                self.scheduler.resume_admission()
-            self.reloading = False
-        self.loaded_step, self.loaded_path = step, path
-        self._loaded_key = key
-        self.reloads += 1
+            self.reloading = True
+            try:
+                try:
+                    params = self.loader(path)
+                except Exception as e:
+                    logger.warning(f"hot-reload: failed to load {path}: {e}")
+                    return False
+                if self.scheduler is not None:
+                    if not self.scheduler.drain(self.drain_timeout_s):
+                        logger.warning(
+                            "hot-reload: drain timed out after "
+                            f"{self.drain_timeout_s}s; swapping with requests in flight"
+                        )
+                self.engine.set_params(params)
+            finally:
+                if self.scheduler is not None:
+                    self.scheduler.resume_admission()
+                self.reloading = False
+            self.loaded_step, self.loaded_path = step, path
+            self._loaded_key = key
+            self.reloads += 1
         if self.metrics is not None:
             self.metrics.inc("checkpoint_reloads_total")
             self.metrics.set_gauge("checkpoint_step", step)
@@ -154,6 +168,7 @@ class InferenceServer:
         reload_interval_s: float = 5.0,
         fault_injector: Optional["resilience.FaultInjector"] = None,
         checkpoint_loader=load_checkpoint_params,
+        drain_on_term_s: float = 30.0,
     ):
         self.scheduler = scheduler
         self.engine = scheduler.engine
@@ -162,24 +177,31 @@ class InferenceServer:
         self.host = host
         self.port = port
         self.fault_injector = fault_injector
-        self.watcher: Optional[CheckpointWatcher] = None
-        if watch_dir:
-            self.watcher = CheckpointWatcher(
-                self.engine, watch_dir, reload_interval_s, self.metrics,
-                loader=checkpoint_loader, scheduler=self.scheduler,
-            )
+        self.drain_on_term_s = float(drain_on_term_s)
+        # the watcher always exists (it is also the /admin/reload
+        # drain-swap implementation); its poll thread only starts when a
+        # watch_dir is configured — supervised replicas run without one
+        # and reload exclusively on the supervisor's explicit paths
+        self.watcher = CheckpointWatcher(
+            self.engine, watch_dir or None, reload_interval_s, self.metrics,
+            loader=checkpoint_loader, scheduler=self.scheduler,
+        )
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._shutdown_done = False
 
     # ------------------------------------------------------------------
 
     @property
     def ready(self) -> bool:
         """Readiness (vs liveness): able to take traffic NOW — the engine
-        holds weights and no checkpoint reload is draining/swapping."""
+        holds weights, no checkpoint reload is draining/swapping, and the
+        scheduler is not in reject-new drain mode."""
         if not self.engine.has_params:
             return False
-        if self.watcher is not None and self.watcher.reloading:
+        if self.watcher.reloading:
+            return False
+        if not self.scheduler.accepting:
             return False
         return True
 
@@ -191,7 +213,7 @@ class InferenceServer:
         override = getattr(injector, "stale_checkpoint_step", None) if injector else None
         if override is not None:
             return int(override)
-        return self.watcher.loaded_step if self.watcher else None
+        return self.watcher.loaded_step
 
     # ------------------------------------------------------------------
 
@@ -234,6 +256,40 @@ class InferenceServer:
             out["text"] = self.tokenizer.decode(req.token_ids)
         return out
 
+    # ------------------------------------------------------------------
+    # Admin surface (fleet supervisor orchestration)
+    # ------------------------------------------------------------------
+
+    def _handle_admin(self, path: str, payload: Dict) -> Dict:
+        """``POST /admin/drain|undrain|reload``: the replica-side half of
+        a supervisor-orchestrated rolling weight sync. Drain flips the
+        scheduler into reject-new/finish-inflight mode (readiness goes
+        off so routers stop dispatching); reload performs the watcher's
+        drain-swap on an explicit checkpoint path (or a watch_dir scan
+        when no path is given); undrain reopens admission."""
+        if path == "/admin/drain":
+            self.scheduler.reject_new()
+            wait_s = payload.get("wait_s")
+            idle = self.scheduler.wait_idle(float(wait_s)) if wait_s else None
+            return {"draining": True, "idle": idle}
+        if path == "/admin/undrain":
+            self.scheduler.accept_new()
+            return {"draining": False}
+        if path == "/admin/reload":
+            ckpt = payload.get("path")
+            if ckpt is not None:
+                reloaded = self.watcher.load_path(str(ckpt))
+            elif self.watcher.watch_dir:
+                reloaded = self.watcher.poll_once()
+            else:
+                raise ValueError("reload needs 'path' (server has no watch_dir)")
+            return {
+                "reloaded": bool(reloaded),
+                "checkpoint_step": self._effective_checkpoint_step(),
+                "reloads": self.watcher.reloads,
+            }
+        raise ValueError(f"unknown admin endpoint {path}")
+
     def _make_handler(self):
         server = self  # live reference: tests can swap fault_injector mid-run
 
@@ -252,7 +308,21 @@ class InferenceServer:
                 self._reply(code, json.dumps(obj).encode(), headers=headers)
 
             def do_POST(self):  # noqa: N802
-                if self.path.rstrip("/") not in ("", "/generate"):
+                path = self.path.rstrip("/")
+                if path.startswith("/admin/"):
+                    # the control plane is exempt from injected data-path
+                    # faults: a supervisor must be able to drain/reload a
+                    # replica whose request path is misbehaving
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        payload = json.loads(self.rfile.read(length) or b"{}")
+                        self._reply_json(200, server._handle_admin(path, payload))
+                    except (ValueError, TypeError) as e:
+                        self._reply_json(400, {"error": str(e)})
+                    except Exception as e:  # pragma: no cover - defensive
+                        self._reply_json(500, {"error": repr(e)})
+                    return
+                if path not in ("", "/generate"):
                     self.send_error(404)
                     return
                 injector = server.fault_injector
@@ -298,6 +368,15 @@ class InferenceServer:
                         headers={"Retry-After": str(max(1, int(e.retry_after)))},
                     )
                     return
+                except DrainingError as e:
+                    # reject-new drain mode (graceful shutdown / admin
+                    # drain): transient — routers fail over elsewhere
+                    self._reply_json(
+                        503,
+                        {"error": "server draining, retry elsewhere"},
+                        headers={"Retry-After": str(max(1, int(e.retry_after)))},
+                    )
+                    return
                 except (ValueError, TypeError) as e:
                     self._reply_json(400, {"error": str(e)})
                     return
@@ -320,6 +399,19 @@ class InferenceServer:
                     )
                     return
                 if path in ("", "/healthz"):
+                    injector = server.fault_injector
+                    if injector is not None and getattr(injector, "healthz_hang_s", 0):
+                        # wedged replica: the process is up but its
+                        # health endpoint never answers — supervisors
+                        # must detect this via probe timeouts and
+                        # kill/respawn, not wait forever
+                        time.sleep(injector.healthz_hang_s)
+                        self.close_connection = True
+                        try:
+                            self.connection.close()
+                        except OSError:
+                            pass
+                        return
                     watcher = server.watcher
                     ready = server.ready
                     self._reply_json(200, {
@@ -329,13 +421,14 @@ class InferenceServer:
                         "status": "ok" if ready else "degraded",
                         "live": True,
                         "ready": ready,
-                        "reloading": bool(watcher.reloading) if watcher else False,
+                        "reloading": bool(watcher.reloading),
+                        "draining": not server.scheduler.accepting,
                         "slots_total": server.engine.num_slots,
                         "slots_active": server.engine.active_slots,
                         "queue_depth": int(server.metrics.get("queue_depth")),
                         "param_version": server.engine.param_version,
                         "checkpoint_step": server._effective_checkpoint_step(),
-                        "reloads": watcher.reloads if watcher else 0,
+                        "reloads": watcher.reloads,
                     })
                     return
                 self.send_error(404)
@@ -350,8 +443,9 @@ class InferenceServer:
     def _bind(self) -> None:
         self._httpd = ThreadingHTTPServer((self.host, self.port), self._make_handler())
         self.port = self._httpd.server_address[1]  # resolve port 0
+        self._shutdown_done = False
         self.scheduler.start()
-        if self.watcher is not None:
+        if self.watcher.watch_dir:
             self.watcher.start()
 
     @property
@@ -368,17 +462,64 @@ class InferenceServer:
         return self.url
 
     def serve(self) -> None:
-        """Blocking serve (the standalone policy-server process)."""
+        """Blocking serve (the standalone policy-server process).
+
+        SIGTERM/SIGINT trigger a graceful drain-then-exit: the scheduler
+        flips to reject-new (new requests answer 503 + Retry-After, so a
+        fleet router fails them over), in-flight decodes run to
+        completion and their replies go out over the still-open listener,
+        and only then does the process exit — a preempted replica never
+        turns completed work into client connection resets."""
+        import signal as _signal
+
         self._bind()
         logger.info(f"Inference server listening on :{self.port}")
+
+        def _graceful(signum):
+            logger.warning(
+                f"signal {signum}: draining scheduler (reject-new) before exit"
+            )
+            self.scheduler.reject_new()
+            self.scheduler.wait_idle(self.drain_on_term_s)
+            self._httpd.shutdown()  # unblocks serve_forever below
+
+        def _on_term(signum, frame):
+            threading.Thread(
+                target=_graceful, args=(signum,),
+                name="trlx-tpu-server-drain", daemon=True,
+            ).start()
+
+        previous = {}
+        try:  # signal handlers only install from the main thread
+            for sig in (_signal.SIGTERM, _signal.SIGINT):
+                previous[sig] = _signal.signal(sig, _on_term)
+        except ValueError:
+            previous = {}
         try:
             self._httpd.serve_forever()
         finally:
-            self.shutdown()
+            for sig, handler in previous.items():
+                _signal.signal(sig, handler)
+            self.shutdown(drain_s=self.drain_on_term_s)
 
-    def shutdown(self) -> None:
-        if self.watcher is not None:
-            self.watcher.stop()
+    def shutdown(self, drain_s: float = 0.0) -> None:
+        """Stop serving. With `drain_s > 0` the scheduler is drained
+        FIRST (reject-new, finish-inflight) so in-flight requests
+        complete and reply before the listener closes — the ordering a
+        graceful SIGTERM needs. `drain_s == 0` keeps the original abrupt
+        semantics (in-flight requests finish as "shutdown"), which is
+        what replica-kill fault injection wants."""
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        self.watcher.stop()
+        if drain_s > 0:
+            self.scheduler.reject_new()
+            if not self.scheduler.wait_idle(drain_s):
+                logger.warning(
+                    f"shutdown: drain timed out after {drain_s}s; "
+                    "remaining requests will finish as 'shutdown'"
+                )
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -487,6 +487,14 @@ class FaultInjector:
     stuck behind the weight sync) without producing real checkpoints, and
     `kill_replica` takes a whole in-process server down mid-rollout.
 
+    Supervisor-level faults (trlx_tpu/inference/supervisor.py): seats in
+    `crash_loop_replicas` are killed `crash_loop_after_s` after every
+    (re)spawn — a crash-looping replica the supervisor must quarantine
+    once its flap budget is spent. `healthz_hang_s > 0` wedges a
+    server's /healthz (held socket, no answer): the process looks alive
+    but its health endpoint times out, so supervisors must detect hangs
+    via probe deadlines, not connection refusals.
+
     Train-side faults for sentinel tests (trlx_tpu/sentinel.py): the
     trainer consults `train_fault(step)` before each optimizer step and,
     per the schedule, poisons the minibatch rewards with NaN (NaN loss ->
@@ -507,6 +515,9 @@ class FaultInjector:
         hang_s: float = 30.0,
         slow_s: float = 0.25,
         stale_checkpoint_step: Optional[int] = None,
+        crash_loop_replicas: Iterable[int] = (),
+        crash_loop_after_s: float = 0.25,
+        healthz_hang_s: float = 0.0,
         nan_grad_steps: Iterable[int] = (),
         loss_spike_steps: Iterable[int] = (),
         hang_steps: Iterable[int] = (),
@@ -520,6 +531,9 @@ class FaultInjector:
         self.hang_s = float(hang_s)
         self.slow_s = float(slow_s)
         self.stale_checkpoint_step = stale_checkpoint_step
+        self.crash_loop_replicas = set(int(s) for s in crash_loop_replicas)
+        self.crash_loop_after_s = float(crash_loop_after_s)
+        self.healthz_hang_s = float(healthz_hang_s)
         self.nan_grad_steps = set(int(s) for s in nan_grad_steps)
         self.loss_spike_steps = set(int(s) for s in loss_spike_steps)
         self.hang_steps = set(int(s) for s in hang_steps)

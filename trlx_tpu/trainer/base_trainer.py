@@ -872,6 +872,12 @@ class TPUTrainer(BaseRLTrainer):
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
+            # a trainer-launched rollout fleet (rollout_fleet_supervised)
+            # must not outlive learn(): stop supervision, kill replicas,
+            # close the router
+            shutdown_fleet = getattr(self, "shutdown_rollout_fleet", None)
+            if shutdown_fleet is not None:
+                shutdown_fleet()
             if getattr(self, "_profiling", False):
                 jax.profiler.stop_trace()
                 self._profiling = False

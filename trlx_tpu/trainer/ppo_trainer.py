@@ -146,8 +146,11 @@ class PPOTrainer(TPUTrainer):
         self._cache_cast_fn = None
         # Disaggregated rollouts (train.rollout_backend="fleet"): lazy
         # ReplicaRouter over the inference replicas; None under the
-        # default "local" backend (bit-identical pre-fleet path).
+        # default "local" backend (bit-identical pre-fleet path). With
+        # train.rollout_fleet_supervised the trainer also launches and
+        # supervises the replicas themselves (FleetSupervisor).
         self._rollout_router = None
+        self._rollout_supervisor = None
 
     def _build_ref_params(self):
         """Extract + place the frozen reference subtree (overridden by the
@@ -431,11 +434,16 @@ class PPOTrainer(TPUTrainer):
         return True
 
     def _get_rollout_router(self):
-        """Build (once) the ReplicaRouter from train.rollout_fleet_*."""
+        """Build (once) the ReplicaRouter from train.rollout_fleet_*.
+        Under train.rollout_fleet_supervised the router is owned by a
+        FleetSupervisor that launches the replicas itself."""
         if self._rollout_router is None:
+            train = self.config.train
+            if getattr(train, "rollout_fleet_supervised", False):
+                self._rollout_router = self._start_rollout_supervisor().router
+                return self._rollout_router
             from trlx_tpu.inference.fleet import ReplicaRouter
 
-            train = self.config.train
             urls = list(getattr(train, "rollout_fleet_urls", None) or [])
             if not urls:
                 raise ValueError(
@@ -448,6 +456,74 @@ class PPOTrainer(TPUTrainer):
             )
             self._rollout_router = ReplicaRouter(urls, **kwargs)
         return self._rollout_router
+
+    def _start_rollout_supervisor(self):
+        """Launch the self-supervised rollout fleet: `rollout_fleet_size`
+        in-process thread replicas (+ `rollout_fleet_spares` warm spares)
+        spawned from the trainer's own serve(), lifecycle-managed by a
+        FleetSupervisor — crashed replicas respawn with backoff,
+        crash-loopers quarantine, and new manifest-complete checkpoints
+        under train.checkpoint_dir roll through the fleet one replica at
+        a time (capacity >= N-1 throughout)."""
+        if self._rollout_supervisor is None:
+            from trlx_tpu.inference.supervisor import FleetSupervisor, ThreadReplica
+
+            train = self.config.train
+            sup_kwargs = dict(
+                getattr(train, "rollout_fleet_supervisor_kwargs", None) or {}
+            )
+            router_kwargs = dict(getattr(train, "rollout_fleet_kwargs", None) or {})
+            router_kwargs.setdefault(
+                "max_staleness_steps",
+                getattr(train, "rollout_max_staleness_steps", 1),
+            )
+            watch_dir = sup_kwargs.pop("watch_dir", train.checkpoint_dir)
+
+            def factory(seat_index):
+                def boot():
+                    # watch_dir="" (-> None): replicas must NOT self-watch
+                    # checkpoints — the supervisor owns reloads (rolling,
+                    # one replica at a time)
+                    server = self.serve(
+                        host="127.0.0.1", port=0, watch_dir="", background=True
+                    )
+                    # replica-level fault injection (healthz_hang_s,
+                    # kill_replica) follows the trainer's injector
+                    server.fault_injector = self.fault_injector
+                    return server
+
+                return ThreadReplica(boot)
+
+            supervisor = FleetSupervisor(
+                factory,
+                num_replicas=int(getattr(train, "rollout_fleet_size", 2)),
+                spares=int(getattr(train, "rollout_fleet_spares", 0)),
+                router_kwargs=router_kwargs,
+                watch_dir=watch_dir,
+                fault_injector=self.fault_injector,
+                **sup_kwargs,
+            )
+            supervisor.start()
+            if not supervisor.wait_ready(timeout_s=supervisor.start_timeout_s):
+                supervisor.stop()
+                raise RuntimeError(
+                    "supervised rollout fleet failed to reach full capacity "
+                    f"within {supervisor.start_timeout_s}s"
+                )
+            self._rollout_supervisor = supervisor
+        return self._rollout_supervisor
+
+    def shutdown_rollout_fleet(self) -> None:
+        """Tear down the rollout fleet: stop supervision, kill thread
+        replicas, close the router. Safe to call when no fleet was ever
+        started; learn() calls this on the way out so replicas never
+        outlive the trainer."""
+        supervisor, self._rollout_supervisor = self._rollout_supervisor, None
+        router, self._rollout_router = self._rollout_router, None
+        if supervisor is not None:
+            supervisor.stop()  # kills replicas + closes the router it owns
+        elif router is not None:
+            router.close()
 
     def _fleet_generate(self, batch, gen_kwargs, trainer_step: int = 0):
         """Generate one chunk on the rollout fleet; same out-dict shape as
@@ -469,7 +545,15 @@ class PPOTrainer(TPUTrainer):
             for row, mask in zip(input_ids, attention_mask)
         ]
         router = self._get_rollout_router()
-        router.set_trainer_step(trainer_step)
+        if self._rollout_supervisor is not None:
+            # supervised replicas only advance when the supervisor rolls
+            # a checkpoint through the fleet, so the staleness bound
+            # anchors to the last synced step — anchoring to the raw
+            # trainer step would blacklist the whole fleet whenever
+            # checkpoint cadence lags the optimizer
+            router.set_trainer_step(self._rollout_supervisor.synced_step)
+        else:
+            router.set_trainer_step(trainer_step)
         try:
             replies = router.generate(prompts, max_new_tokens=max_new)
         except FleetUnavailableError as e:
@@ -687,6 +771,12 @@ class PPOTrainer(TPUTrainer):
             # router lifetime counters (not per-chunk, so merged after
             # the per-chunk averaging above)
             for k, v in self._rollout_router.stats().items():
+                if isinstance(v, (int, float)):
+                    stats[f"fleet/{k}"] = float(v)
+        if use_fleet and self._rollout_supervisor is not None:
+            # supervisor lifecycle counters (respawns, quarantines,
+            # promotions, rolling-sync progress, live capacity)
+            for k, v in self._rollout_supervisor.stats().items():
                 if isinstance(v, (int, float)):
                     stats[f"fleet/{k}"] = float(v)
         self.mean_kl = stats["policy/sqrt_kl"] ** 2

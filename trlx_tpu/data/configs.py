@@ -198,6 +198,22 @@ class InferenceConfig:
         method's `gen_kwargs` (HF names: temperature, top_k, top_p,
         do_sample, ...). Fixed at server start — per-request overrides
         are limited to max_new_tokens.
+    :param kv_paging: allocate KV cache from a global block arena through
+        per-slot block tables instead of one full-length row per slot —
+        memory scales with resident tokens, not slots × max length.
+    :param kv_block_size: tokens per KV block (paged mode). Also the
+        prefix-sharing granularity.
+    :param kv_pool_blocks: total arena blocks; 0 sizes the arena to the
+        fixed-slot equivalent (num_slots × blocks-per-full-row + zero
+        block) so paging is a strict superset at equal HBM.
+    :param kv_cache_dtype: "auto" (model dtype) | "f32" | "bf16" |
+        "int8" (per-token-per-head symmetric quantization, paged only —
+        halves/quarters KV bytes at a small logit tolerance).
+    :param prefix_cache: share prompt-prefix KV blocks across requests
+        (exact token-chain keys, refcounted, LRU-evicted when idle);
+        requires kv_paging.
+    :param prefix_cache_capacity: max idle cached blocks retained after
+        release; 0 = bounded only by allocation pressure.
     """
 
     num_slots: int = 8
@@ -213,6 +229,12 @@ class InferenceConfig:
     watch_dir: Optional[str] = None
     reload_interval_s: float = 5.0
     gen_kwargs: Dict[str, Any] = field(default_factory=dict)
+    kv_paging: bool = False
+    kv_block_size: int = 32
+    kv_pool_blocks: int = 0
+    kv_cache_dtype: str = "auto"
+    prefix_cache: bool = False
+    prefix_cache_capacity: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

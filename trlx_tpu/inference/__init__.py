@@ -1,7 +1,10 @@
 """Policy inference subsystem: generation-as-a-service.
 
 - `engine` — continuous-batching `InferenceEngine` over a slot-based
-  KV-cache pool (jitted prefill / decode_step);
+  KV-cache pool (jitted prefill / decode_step), optionally paged
+  (`kv_paging`) with shared-prefix block reuse and int8 KV quantization;
+- `paging` — host-side `BlockPool`: free-list block allocation,
+  refcounted exact-match prefix store, LRU idle eviction;
 - `scheduler` — FIFO admission, max-wait batching, bounded queue with
   backpressure, per-request deadlines, drain for weight sync,
   reject-new/finish-inflight draining for graceful shutdown;
@@ -21,6 +24,7 @@ from trlx_tpu.inference.client import remote_generate
 from trlx_tpu.inference.engine import InferenceEngine
 from trlx_tpu.inference.fleet import FleetUnavailableError, Replica, ReplicaRouter
 from trlx_tpu.inference.metrics import InferenceMetrics
+from trlx_tpu.inference.paging import BlockPool, KVPoolExhaustedError, prefix_keys
 from trlx_tpu.inference.scheduler import (
     DrainingError,
     InferenceRequest,
@@ -40,6 +44,7 @@ from trlx_tpu.inference.supervisor import (
 )
 
 __all__ = [
+    "BlockPool",
     "CheckpointWatcher",
     "DrainingError",
     "FleetSupervisor",
@@ -48,6 +53,7 @@ __all__ = [
     "InferenceMetrics",
     "InferenceRequest",
     "InferenceServer",
+    "KVPoolExhaustedError",
     "QueueFullError",
     "Replica",
     "ReplicaHandle",
@@ -56,5 +62,6 @@ __all__ = [
     "SubprocessReplica",
     "ThreadReplica",
     "load_checkpoint_params",
+    "prefix_keys",
     "remote_generate",
 ]

@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from trlx_tpu.models.transformer import init_kv_cache
+from trlx_tpu.inference.paging import BlockPool, KVPoolExhaustedError, prefix_keys
+from trlx_tpu.models.transformer import init_kv_cache, init_paged_kv_arena
 from trlx_tpu.ops.quant import dequantize_tree
 from trlx_tpu.ops.sampling import (
     GenerationConfig,
@@ -63,6 +64,16 @@ def _pow2_bucket(n: int, cap: int) -> int:
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+_KV_DTYPES = {
+    "auto": None,
+    "f32": jnp.float32,
+    "float32": jnp.float32,
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
 
 
 class InferenceEngine:
@@ -91,6 +102,12 @@ class InferenceEngine:
         spec_k: int = 0,
         spec_split: int = 0,
         spec_draft_rank: int = 64,
+        kv_paging: bool = False,
+        kv_block_size: int = 32,
+        kv_pool_blocks: int = 0,
+        kv_cache_dtype: str = "auto",
+        prefix_cache: bool = False,
+        prefix_cache_capacity: int = 0,
     ):
         if getattr(model_cfg, "is_seq2seq", False):
             raise NotImplementedError(
@@ -128,9 +145,46 @@ class InferenceEngine:
         self.spec_k = int(spec_k)
         self.spec_split = int(spec_split)
         self.spec_draft_rank = int(spec_draft_rank)
+        self.kv_paging = bool(kv_paging)
+        self.kv_block_size = int(kv_block_size)
+        self.prefix_cache = bool(prefix_cache) and self.kv_paging
+        if kv_cache_dtype not in _KV_DTYPES:
+            raise ValueError(
+                f"kv_cache_dtype {kv_cache_dtype!r} not in {sorted(_KV_DTYPES)}"
+            )
+        self.kv_cache_dtype = _KV_DTYPES[kv_cache_dtype] or getattr(
+            model_cfg, "dtype", jnp.float32
+        )
+        if self.kv_cache_dtype == jnp.int8 and not self.kv_paging:
+            raise NotImplementedError("int8 KV cache requires kv_paging")
+        if prefix_cache and not kv_paging:
+            raise ValueError("prefix_cache requires kv_paging")
         # a speculative round may write spec_k cache rows past a slot's
         # budget before the rollback clears them — give the pool the slack
         self._cache_len = self.max_len + self.spec_k
+        if self.kv_paging:
+            if self.kv_block_size < 1:
+                raise ValueError("kv_block_size must be >= 1")
+            # every slot's logical view spans n_tbl blocks; cache_len
+            # rounds up to a whole number of blocks
+            self._cache_len = _round_up(self._cache_len, self.kv_block_size)
+            self._n_tbl = self._cache_len // self.kv_block_size
+            # auto-size to fixed-pool capacity parity: every slot can hold
+            # a worst-case request (plus the reserved zero block)
+            self._n_blocks = int(kv_pool_blocks) or (
+                self.num_slots * self._n_tbl + 1
+            )
+            self._block_pool = BlockPool(
+                self._n_blocks, self.kv_block_size,
+                prefix_cache=self.prefix_cache,
+                idle_capacity=int(prefix_cache_capacity),
+            )
+            self._slot_blocks: Dict[int, List[int]] = {}
+            # BlockPool is plain Python touched by the driver thread
+            # (insert/reclaim) AND the hot-reload thread (flush_cached)
+            self._kv_lock = threading.Lock()
+        else:
+            self._block_pool = None
 
         self._params = params
         self._param_lock = threading.Lock()
@@ -147,7 +201,26 @@ class InferenceEngine:
             m[np.asarray(gen_cfg.suppress_tokens, np.int64)] = -np.inf
             self._suppress = jnp.asarray(m)
 
-        cache = init_kv_cache(model_cfg, P, self._cache_len)
+        if self.kv_paging:
+            # paged mode: per-layer arenas shared by every slot + one
+            # block table per slot; mask/pos/row_index stay dense per-slot
+            # (they are tiny). Table entries default to the zero block.
+            layers = init_paged_kv_arena(
+                model_cfg, self._n_blocks, self.kv_block_size,
+                dtype=self.kv_cache_dtype,
+            )
+            cache = {
+                "layers": layers,
+                "mask": jnp.zeros((P, self._cache_len), jnp.int32),
+                "pos": jnp.zeros((P,), jnp.int32),
+            }
+        else:
+            # "auto" resolves to cfg.dtype, so the flag-off pool is
+            # byte-identical to before; f32/bf16 overrides re-type the
+            # fixed rows in place
+            cache = init_kv_cache(
+                model_cfg, P, self._cache_len, dtype=self.kv_cache_dtype
+            )
         # Fused sampling: the pool carries each slot's PRE-SAMPLED next
         # token + its policy logprob instead of a [P, V] f32 logits bank —
         # suppress/warping/categorical draw happen inside the same jitted
@@ -166,8 +239,11 @@ class InferenceEngine:
             "next_logprob": jnp.zeros((P,), jnp.float32),
             "rng": jax.random.PRNGKey(seed),
         }
+        if self.kv_paging:
+            self._pool["table"] = jnp.zeros((P, self._n_tbl), jnp.int32)
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._insert_fns: Dict[int, Callable] = {}
+        self._paged_insert_fns: Dict[Tuple[int, int], Callable] = {}
         self._decode_fn = self._make_spec_decode() if self.spec_k > 0 else self._make_decode()
 
     # ------------------------------------------------------------------
@@ -184,6 +260,13 @@ class InferenceEngine:
         head) is atomic under the same lock. Returns the new param
         version."""
         head = self._build_spec_head(params) if self.spec_k > 0 else None
+        if self.prefix_cache:
+            # cached prefixes hold K/V computed under the OLD weights:
+            # in-flight requests may finish on their stale prefix (same
+            # contract as the fixed pool), but new requests must not
+            # silently mix old-prefix K/V with new-weight decode
+            with self._kv_lock:
+                self._block_pool.flush_cached()
         with self._param_lock:
             self._params = params
             self._spec_head = head
@@ -300,6 +383,72 @@ class InferenceEngine:
             self._insert_fns[pb] = jax.jit(insert, donate_argnums=(0,))
         return self._insert_fns[pb]
 
+    def _get_paged_insert(self, pb: int, plen: int) -> Callable:
+        """Paged-mode prefill+insert, jitted per (rows, suffix-width)
+        bucket: one `prefill_rows` call writes each row's RIGHT-padded
+        prompt suffix straight into the shared arena through its fresh
+        block table (no per-request cache copy to scatter afterwards —
+        the arena IS the pool), seeds rows behind a cached prefix at
+        column `shared_len`, and fuses the first-token draw."""
+        key = (pb, plen)
+        if key not in self._paged_insert_fns:
+            model, S, P = self.model, self._cache_len, self.num_slots
+            sample_fused = self._sample_fused
+
+            def insert(pool, params, ids, tmask, tables, slot_ids, max_new, shared_len):
+                params = dequantize_tree(params)
+                # temp per-request cache rows backed by the SHARED arena;
+                # a cached prefix is already resident in blocks
+                # tables[:, : shared_len // block], so only its mask bits
+                # need seeding — prefill resumes at column shared_len
+                layers = [dict(al, table=tables) for al in pool["layers"]]
+                seed_mask = (
+                    jnp.arange(S)[None, :] < shared_len[:, None]
+                ).astype(jnp.int32)
+                cache = {
+                    "layers": layers,
+                    "mask": seed_mask,
+                    "pos": shared_len,
+                    "row_index": shared_len,
+                }
+                logits, new_cache = model.apply(
+                    {"params": params}, ids, cache, tmask,
+                    method=type(model).prefill_rows,
+                )
+                # per-row LAST-valid-position logits (right padding)
+                lens = tmask.sum(-1).astype(jnp.int32)
+                last = jnp.take_along_axis(
+                    logits, jnp.clip(lens - 1, 0, plen - 1)[:, None, None], axis=1
+                )[:, 0].astype(jnp.float32)
+                rng, key_ = jax.random.split(pool["rng"])
+                token, lp = sample_fused(last, key_, 0)
+                arena = [
+                    {k2: v2 for k2, v2 in layer.items() if k2 != "table"}
+                    for layer in new_cache["layers"]
+                ]
+                # padding rows carry slot_id == num_slots and all-OOB
+                # tables: both their arena writes (inside prefill_rows)
+                # and these pool scatters are dropped
+                return {
+                    **pool,
+                    "layers": arena,
+                    "table": pool["table"].at[slot_ids].set(tables),
+                    "mask": pool["mask"].at[slot_ids].set(new_cache["mask"]),
+                    "pos": pool["pos"].at[slot_ids].set(new_cache["pos"]),
+                    "row_index": pool["row_index"].at[slot_ids].set(
+                        new_cache["row_index"]
+                    ),
+                    "step": pool["step"].at[slot_ids].set(0),
+                    "active": pool["active"].at[slot_ids].set(1),
+                    "max_new": pool["max_new"].at[slot_ids].set(max_new),
+                    "next_token": pool["next_token"].at[slot_ids].set(token),
+                    "next_logprob": pool["next_logprob"].at[slot_ids].set(lp),
+                    "rng": rng,
+                }
+
+            self._paged_insert_fns[key] = jax.jit(insert, donate_argnums=(0,))
+        return self._paged_insert_fns[key]
+
     def insert_requests(
         self,
         rows: Sequence[Tuple[np.ndarray, int]],  # (unpadded prompt ids, max_new)
@@ -307,21 +456,17 @@ class InferenceEngine:
     ) -> None:
         """Prefill `rows` (length-bucketed, left-padded) and scatter them
         into the given free slots. Requests are grouped by prompt-width
-        bucket; each group prefills as one jitted call."""
+        bucket; each group prefills as one jitted call. Paged mode routes
+        to `_insert_paged` (block allocation + prefix-store probing +
+        right-padded suffix prefill)."""
         assert len(rows) == len(slot_ids)
+        if self.kv_paging:
+            self._insert_paged(rows, slot_ids)
+            return
         pad_id = self.gen_cfg.pad_token_id
         groups: Dict[int, List[Tuple[np.ndarray, int, int]]] = {}
         for (ids, max_new), slot in zip(rows, slot_ids):
-            ids = np.asarray(ids, np.int32).reshape(-1)
-            if ids.size == 0 or ids.size > self.max_prompt_len:
-                raise ValueError(
-                    f"prompt length {ids.size} outside (0, {self.max_prompt_len}]"
-                )
-            if not 0 < max_new <= self.gen_cfg.max_new_tokens:
-                raise ValueError(
-                    f"max_new_tokens {max_new} outside (0, "
-                    f"{self.gen_cfg.max_new_tokens}]"
-                )
+            ids = self._check_row(ids, max_new)
             plen = _round_up(ids.size, self.prompt_bucket)
             groups.setdefault(plen, []).append((ids, int(max_new), int(slot)))
 
@@ -352,6 +497,133 @@ class InferenceEngine:
                     jnp.asarray(slots_arr), jnp.asarray(max_new_arr),
                 )
 
+    def _check_row(self, ids, max_new: int) -> np.ndarray:
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size == 0 or ids.size > self.max_prompt_len:
+            raise ValueError(
+                f"prompt length {ids.size} outside (0, {self.max_prompt_len}]"
+            )
+        if not 0 < max_new <= self.gen_cfg.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new} outside (0, "
+                f"{self.gen_cfg.max_new_tokens}]"
+            )
+        return ids
+
+    def _insert_paged(self, rows, slot_ids) -> None:
+        """Paged insert: allocate each request's blocks up front
+        (prompt + max_new + spec_k — no mid-decode OOM, no preemption),
+        probing the prefix store for resident leading blocks first.
+
+        Requests whose probe would hit keys REGISTERED EARLIER IN THIS
+        CALL are deferred one placement round: the registering request's
+        prefill has not been dispatched yet, and a same-program gather of
+        its blocks would read zeros. Each round places at least the first
+        pending request, so this terminates; GRPO's n-way fan-out of one
+        prompt resolves as 1 full prefill + (n-1) suffix prefills batched
+        together in round two."""
+        bs, pool = self.kv_block_size, self._block_pool
+        pending: List[Tuple[np.ndarray, int, int]] = []
+        for (ids, max_new), slot in zip(rows, slot_ids):
+            pending.append((self._check_row(ids, max_new), int(max_new), int(slot)))
+        params = self._current_params()
+        # place every round before dispatching anything, journalling each
+        # placement — on pool exhaustion the whole call rolls back (no
+        # partial prefills, no dangling store keys) so the scheduler can
+        # requeue the batch and retry once blocks free
+        rounds: List[List] = []
+        journal: List[Tuple[int, List[int], List[bytes]]] = []
+        with self._kv_lock:
+            try:
+                while pending:
+                    placed, deferred = [], []
+                    round_keys: set = set()
+                    for ids, max_new, slot in pending:
+                        keys = prefix_keys(ids, bs) if self.prefix_cache else []
+                        if any(k in round_keys for k in keys):
+                            deferred.append((ids, max_new, slot))
+                            continue
+                        shared: List[int] = []
+                        for key in keys:
+                            blk = pool.acquire_cached(key)
+                            if blk is None:
+                                break
+                            shared.append(blk)
+                        if keys:
+                            if shared:
+                                pool.hits += 1
+                            else:
+                                pool.misses += 1
+                        n_cap = -(-(ids.size + max_new + self.spec_k) // bs)
+                        try:
+                            owned = pool.alloc(n_cap - len(shared))
+                        except KVPoolExhaustedError:
+                            pool.release(shared)
+                            raise
+                        blocks = shared + owned
+                        # publish the full-prompt blocks this prefill will
+                        # write (keys cover [0, (L-1)//bs) — at least one
+                        # suffix token always prefills on a future hit)
+                        registered: List[bytes] = []
+                        for j in range(len(shared), len(keys)):
+                            pool.register(keys[j], blocks[j])
+                            round_keys.add(keys[j])
+                            registered.append(keys[j])
+                        self._slot_blocks[slot] = blocks
+                        journal.append((slot, blocks, registered))
+                        T = len(shared) * bs
+                        placed.append((ids[T:], T, blocks, max_new, slot))
+                    rounds.append(placed)
+                    pending = deferred
+            except KVPoolExhaustedError:
+                for slot, blocks, registered in journal:
+                    for key in registered:
+                        pool.unregister(key)
+                    pool.release(blocks)
+                    self._slot_blocks.pop(slot, None)
+                raise
+        # dispatch order between rounds is what makes same-call sharing
+        # sound: a round-2 suffix prefill gathers blocks the round-1
+        # program has already written by the time it runs
+        for placed in rounds:
+            self._flush_paged(placed, params)
+
+    def _flush_paged(self, placed, params) -> None:
+        """Dispatch one placement round's prefills, grouped by suffix
+        width bucket and chunked to `max_prefill_batch`."""
+        pad_id = self.gen_cfg.pad_token_id
+        groups: Dict[int, List] = {}
+        for item in placed:
+            plen = _round_up(len(item[0]), self.prompt_bucket)
+            groups.setdefault(plen, []).append(item)
+        for plen, members in groups.items():
+            for i in range(0, len(members), self.max_prefill_batch):
+                chunk = members[i : i + self.max_prefill_batch]
+                pb = _pow2_bucket(len(chunk), self.max_prefill_batch)
+                ids_arr = np.full((pb, plen), pad_id, np.int32)
+                tmask = np.zeros((pb, plen), np.int32)
+                tables = np.full((pb, self._n_tbl), self._n_blocks, np.int32)
+                slots_arr = np.full((pb,), self.num_slots, np.int32)
+                max_new_arr = np.full((pb,), self.gen_cfg.max_new_tokens, np.int32)
+                shared_arr = np.zeros((pb,), np.int32)
+                for j, (suffix, T, blocks, max_new, slot) in enumerate(chunk):
+                    ids_arr[j, : len(suffix)] = suffix  # RIGHT-padded
+                    tmask[j, : len(suffix)] = 1
+                    tables[j, : len(blocks)] = blocks
+                    tables[j, len(blocks) :] = 0  # zero-block padding
+                    slots_arr[j] = slot
+                    max_new_arr[j] = max_new
+                    shared_arr[j] = T
+                # padding rows repeat row 0's tokens but keep all-OOB
+                # tables and OOB slot ids — every write they make drops
+                ids_arr[len(chunk) :] = ids_arr[0]
+                tmask[len(chunk) :] = tmask[0]
+                self._pool = self._get_paged_insert(pb, plen)(
+                    self._pool, params, jnp.asarray(ids_arr), jnp.asarray(tmask),
+                    jnp.asarray(tables), jnp.asarray(slots_arr),
+                    jnp.asarray(max_new_arr), jnp.asarray(shared_arr),
+                )
+
     # ------------------------------------------------------------------
     # Decode
     # ------------------------------------------------------------------
@@ -360,6 +632,7 @@ class InferenceEngine:
         model, gen_cfg = self.model, self.gen_cfg
         pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
         sample_fused = self._sample_fused
+        paged = self.kv_paging
 
         def decode(params, pool):
             params = dequantize_tree(params)
@@ -374,11 +647,22 @@ class InferenceEngine:
                 (token == eos) | (pool["step"] + 1 >= pool["max_new"])
             )
             cache = {k: pool[k] for k in ("layers", "mask", "pos", "row_index")}
+            if paged:
+                # route every layer through the slot block tables; decode
+                # never remaps blocks, so the tables pass through
+                cache["layers"] = [
+                    dict(al, table=pool["table"]) for al in cache["layers"]
+                ]
             logits, new_cache = model.apply(
                 {"params": params}, token[:, None], cache,
                 valid.astype(jnp.int32)[:, None],
                 method=type(model).decode_step_rows,
             )
+            if paged:
+                new_cache = dict(new_cache, layers=[
+                    {k2: v2 for k2, v2 in layer.items() if k2 != "table"}
+                    for layer in new_cache["layers"]
+                ])
             # fused draw of each row's NEXT token from the fresh logits;
             # new_step is per-row, exactly the loop counter each row would
             # see in the while-loop sampler (finished/inactive rows draw
@@ -416,6 +700,7 @@ class InferenceEngine:
         k, split = self.spec_k, self.spec_split
         greedy = (not gen_cfg.do_sample) or (gen_cfg.temperature == 0.0)
         suppress = self._suppress
+        paged = self.kv_paging
 
         def warp(raw_logits, step):
             scores = raw_logits
@@ -431,6 +716,10 @@ class InferenceEngine:
             step0 = pool["step"]
             rng = pool["rng"]
             cache = {key: pool[key] for key in ("layers", "mask", "pos", "row_index")}
+            if paged:
+                cache["layers"] = [
+                    dict(al, table=pool["table"]) for al in cache["layers"]
+                ]
             row_start = pool["row_index"]
             pos_start = pool["pos"]
             f0 = jnp.where(active, pool["next_token"], pad)
@@ -451,10 +740,20 @@ class InferenceEngine:
                     draft_toks.append(f)
             h_block = jnp.concatenate(h_rows, axis=1)
             positions = pos_start[:, None] + jnp.arange(k + 1)[None, :]
-            out = model.apply(
-                {"params": params}, h_block, cache, row_start, positions,
-                split, method=type(model).spec_verify_rows,
-            )
+            if paged:
+                # gate the batched verify's arena writes on row liveness:
+                # a freed slot's stale block table may point at blocks now
+                # owned by other requests, so its writes must drop
+                out = model.apply(
+                    {"params": params}, h_block, cache, row_start, positions,
+                    split, method=type(model).spec_verify_rows,
+                    token_mask=jnp.broadcast_to(act_i[:, None], (P, k + 1)),
+                )
+            else:
+                out = model.apply(
+                    {"params": params}, h_block, cache, row_start, positions,
+                    split, method=type(model).spec_verify_rows,
+                )
             logits_v, new_layers = out[0].astype(jnp.float32), out[2]
             cache = dict(cache, layers=new_layers)
             p_scores = [warp(logits_v[:, j], step0 + 1 + j) for j in range(k + 1)]
@@ -542,9 +841,15 @@ class InferenceEngine:
             new_mask = cache["mask"].at[rows_p, offs].set(
                 (jidx < e[:, None]).astype(cache["mask"].dtype)
             )
+            layers_out = cache["layers"]
+            if paged:
+                layers_out = [
+                    {k2: v2 for k2, v2 in layer.items() if k2 != "table"}
+                    for layer in layers_out
+                ]
             new_pool = {
                 **pool,
-                "layers": cache["layers"],
+                "layers": layers_out,
                 "mask": new_mask,
                 "pos": pos_start + e,
                 "row_index": row_start + e,
@@ -590,6 +895,80 @@ class InferenceEngine:
             return
         idx = jnp.asarray(np.asarray(slots, np.int32))
         self._pool = {**self._pool, "active": self._pool["active"].at[idx].set(0)}
+        self.reclaim_slots(slots)
+
+    def reclaim_slots(self, slots: Sequence[int]) -> None:
+        """Return a finished slot's blocks to the pool (host bookkeeping
+        only — no device op; a freed slot's stale table is harmless
+        because inactive rows' arena writes are gated out). Idempotent;
+        a no-op when paging is off. The scheduler calls this for natural
+        finishes; `release_slots` folds it into cancels."""
+        if not self.kv_paging:
+            return
+        with self._kv_lock:
+            for slot in slots:
+                blocks = self._slot_blocks.pop(int(slot), None)
+                if blocks:
+                    self._block_pool.release(blocks)
+
+    # ------------------------------------------------------------------
+    # Paged-pool accounting (admission + metrics)
+    # ------------------------------------------------------------------
+
+    def projected_blocks(
+        self, prompt_ids, max_new_tokens: int, ignore_cache: bool = False
+    ) -> int:
+        """Blocks this request would claim if admitted now:
+        ceil((prompt + max_new + spec_k) / block_size) minus the leading
+        blocks a read-only prefix-store probe says are resident. 0 when
+        paging is off."""
+        if not self.kv_paging:
+            return 0
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        with self._kv_lock:
+            shared = 0 if ignore_cache else self._block_pool.lookup_chain(ids)
+        n_cap = -(-(ids.size + int(max_new_tokens) + self.spec_k) // self.kv_block_size)
+        return max(1, n_cap - shared)
+
+    def blocks_available(self) -> int:
+        if not self.kv_paging:
+            return 0
+        with self._kv_lock:
+            return self._block_pool.available()
+
+    @property
+    def total_blocks(self) -> int:
+        """Allocatable blocks (zero block excluded); 0 when paging is off."""
+        return self._block_pool.total if self.kv_paging else 0
+
+    def kv_stats(self) -> Dict[str, int]:
+        """Host-side paged-pool counters for metrics/healthz; {} when
+        paging is off."""
+        if not self.kv_paging:
+            return {}
+        cfg = self.model_cfg
+        itemsize = jnp.dtype(self.kv_cache_dtype).itemsize
+        kv_bytes = (
+            2 * cfg.n_layers * self._n_blocks * self.kv_block_size
+            * cfg.kv_heads * cfg.head_dim * itemsize
+        )
+        if self.kv_cache_dtype == jnp.int8:  # f32 scale planes
+            kv_bytes += (
+                2 * cfg.n_layers * self._n_blocks * self.kv_block_size
+                * cfg.kv_heads * 4
+            )
+        with self._kv_lock:
+            pool = self._block_pool
+            return {
+                "kv_blocks_total": pool.total,
+                "kv_blocks_free": pool.available(),
+                "kv_blocks_used": pool.in_use(),
+                "kv_pool_bytes": int(kv_bytes),
+                "prefix_cache_hits": pool.hits,
+                "prefix_cache_misses": pool.misses,
+                "prefix_cache_evictions": pool.evictions,
+                "prefix_cache_idle_blocks": pool.cached_idle(),
+            }
 
     @property
     def active_slots(self) -> int:

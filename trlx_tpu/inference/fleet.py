@@ -102,6 +102,9 @@ class Replica:
         self.failures = 0
         self.last_probe = 0.0  # monotonic; 0 = never probed
         self.last_error: Optional[str] = None
+        # paged KV-pool occupancy from the last probe ({} on legacy or
+        # fixed-slot replicas) — supervisors export these per-replica
+        self.kv: Dict[str, Any] = {}
 
     @property
     def breaker(self) -> resilience.CircuitBreaker:
@@ -119,6 +122,7 @@ class Replica:
             "served": self.served,
             "failures": self.failures,
             "last_error": self.last_error,
+            "kv": dict(self.kv),
         }
 
 
@@ -228,6 +232,8 @@ class ReplicaRouter:
         step = info.get("checkpoint_step")
         rep.checkpoint_step = int(step) if step is not None else None
         rep.param_version = info.get("param_version")
+        kv = info.get("kv")
+        rep.kv = dict(kv) if isinstance(kv, dict) else {}
         rep.last_probe = time.monotonic()
         rep.last_error = None
         return rep.live
@@ -544,6 +550,19 @@ class ReplicaRouter:
             lines.append(f"# TYPE {ns}_{name} gauge")
             for rep in replicas:
                 lines.append(f'{ns}_{name}{{url="{rep.url}"}} {fn(rep)}')
+        # paged KV-pool series, only for replicas whose probes report them
+        kv_gauges = (
+            ("replica_kv_blocks_free", "kv_blocks_free"),
+            ("replica_kv_blocks_used", "kv_blocks_used"),
+            ("replica_kv_pool_bytes", "kv_pool_bytes"),
+        )
+        for name, key in kv_gauges:
+            rows = [r for r in replicas if key in r.kv]
+            if not rows:
+                continue
+            lines.append(f"# TYPE {ns}_{name} gauge")
+            for rep in rows:
+                lines.append(f'{ns}_{name}{{url="{rep.url}"}} {rep.kv[key]}')
         for name, attr in (("replica_served", "served"),
                            ("replica_failures", "failures")):
             lines.append(f"# TYPE {ns}_{name}_total counter")
@@ -551,6 +570,18 @@ class ReplicaRouter:
                 lines.append(
                     f'{ns}_{name}_total{{url="{rep.url}"}} {getattr(rep, attr)}'
                 )
+        kv_counters = (
+            ("replica_prefix_cache_hits", "prefix_cache_hits"),
+            ("replica_prefix_cache_misses", "prefix_cache_misses"),
+            ("replica_prefix_cache_evictions", "prefix_cache_evictions"),
+        )
+        for name, key in kv_counters:
+            rows = [r for r in replicas if key in r.kv]
+            if not rows:
+                continue
+            lines.append(f"# TYPE {ns}_{name}_total counter")
+            for rep in rows:
+                lines.append(f'{ns}_{name}_total{{url="{rep.url}"}} {rep.kv[key]}')
         return "\n".join(lines) + "\n"
 
     def close(self, timeout_s: float = 5.0) -> None:

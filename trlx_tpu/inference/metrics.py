@@ -54,6 +54,13 @@ class InferenceMetrics:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + by
 
+    def set_counter(self, name: str, value: float) -> None:
+        """Sync a counter to an absolute value — for tallies whose source
+        of truth lives elsewhere (the engine's KV block pool) and are
+        mirrored into the registry rather than accumulated here."""
+        with self._lock:
+            self._counters[name] = float(value)
+
     def set_gauge(self, name: str, value: float) -> None:
         with self._lock:
             self._gauges[name] = float(value)

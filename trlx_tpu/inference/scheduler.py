@@ -28,13 +28,17 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from trlx_tpu.inference.metrics import InferenceMetrics
+from trlx_tpu.inference.paging import KVPoolExhaustedError
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
 
 
 class QueueFullError(RuntimeError):
-    """Queue depth limit hit — back off and retry after `retry_after`s."""
+    """Queue depth limit hit — back off and retry after `retry_after`s
+    (derived from observed decode latency × the shortest remaining token
+    budget in flight — the predicted time to the next free slot/blocks —
+    not a constant)."""
 
     def __init__(self, depth: int, retry_after: float = 1.0):
         self.depth = depth
@@ -107,17 +111,15 @@ class Scheduler:
         self._paused = False  # admission gate for drain-on-sync
         self._rejecting = False  # reject-new/finish-inflight shutdown mode
         self._thread: Optional[threading.Thread] = None
+        # EWMA of decode-step wall time, feeding Retry-After predictions
+        self._decode_ewma = 0.0
+        self._slots_active_peak = 0
 
     # ------------------------------------------------------------------
     # Client surface (any thread)
     # ------------------------------------------------------------------
 
-    def submit(
-        self,
-        prompt_ids,
-        max_new_tokens: Optional[int] = None,
-        deadline_s: Optional[float] = None,
-    ) -> InferenceRequest:
+    def _validate(self, prompt_ids, max_new_tokens: Optional[int]):
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -132,6 +134,55 @@ class Scheduler:
                 f"max_new_tokens {max_new} outside (0, "
                 f"{self.engine.gen_cfg.max_new_tokens}]"
             )
+        if getattr(self.engine, "kv_paging", False):
+            need = self.engine.projected_blocks(ids, max_new, ignore_cache=True)
+            if need > self.engine.total_blocks:
+                raise ValueError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"only {self.engine.total_blocks} — it can never be "
+                    "admitted"
+                )
+        return ids, max_new
+
+    def _predicted_retry_after(self) -> float:
+        """Seconds until the next slot (and its KV blocks) should free:
+        observed decode-step latency × the shortest remaining token
+        budget in flight. Falls back to a one-wave-per-pool queue
+        estimate before any decode step has been timed. Call with
+        `self._cond` held."""
+        if self._decode_ewma > 0.0 and self._slot_req:
+            remaining = min(
+                max(req.max_new_tokens - len(req.token_ids), 1)
+                for req in self._slot_req.values()
+            )
+            per_step = max(1, getattr(self.engine, "spec_k", 0) + 1)
+            steps = -(-remaining // per_step)
+            return max(0.05, self._decode_ewma * steps)
+        return float(max(1, len(self._queue) // max(self.engine.num_slots, 1)))
+
+    def _enqueue(self, reqs: List[InferenceRequest]) -> None:
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            if self._rejecting:
+                self.metrics.inc("requests_rejected_total", len(reqs))
+                raise DrainingError(retry_after=self._predicted_retry_after())
+            if len(self._queue) + len(reqs) > self.max_queue_depth:
+                self.metrics.inc("requests_rejected_total", len(reqs))
+                raise QueueFullError(
+                    len(self._queue), retry_after=self._predicted_retry_after()
+                )
+            self._queue.extend(reqs)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify_all()
+
+    def submit(
+        self,
+        prompt_ids,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> InferenceRequest:
+        ids, max_new = self._validate(prompt_ids, max_new_tokens)
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = InferenceRequest(
             id=next(self._ids),
@@ -139,22 +190,37 @@ class Scheduler:
             max_new_tokens=max_new,
             deadline=(time.monotonic() + dl) if dl else None,
         )
-        with self._cond:
-            if not self._running:
-                raise RuntimeError("scheduler is not running")
-            if self._rejecting:
-                self.metrics.inc("requests_rejected_total")
-                raise DrainingError()
-            if len(self._queue) >= self.max_queue_depth:
-                self.metrics.inc("requests_rejected_total")
-                # rough drain estimate: one queued generation ahead of us
-                # per free wave of the pool
-                waves = max(1, len(self._queue) // max(self.engine.num_slots, 1))
-                raise QueueFullError(len(self._queue), retry_after=float(waves))
-            self._queue.append(req)
-            self.metrics.set_gauge("queue_depth", len(self._queue))
-            self._cond.notify_all()
+        self._enqueue([req])
         return req
+
+    def submit_n(
+        self,
+        prompt_ids,
+        n: int,
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> List[InferenceRequest]:
+        """GRPO-style fan-out: enqueue `n` independent generations of one
+        prompt as ADJACENT queue entries under one lock, so the paged
+        engine admits them in one batch and its prefix store turns the
+        group into one full prefill plus (n-1) suffix prefills sharing
+        the prompt's KV blocks. All-or-nothing against queue depth."""
+        if n < 1:
+            raise ValueError(f"n must be >= 1, got {n}")
+        ids, max_new = self._validate(prompt_ids, max_new_tokens)
+        dl = deadline_s if deadline_s is not None else self.default_deadline_s
+        deadline = (time.monotonic() + dl) if dl else None
+        reqs = [
+            InferenceRequest(
+                id=next(self._ids),
+                prompt_ids=ids,
+                max_new_tokens=max_new,
+                deadline=deadline,
+            )
+            for _ in range(n)
+        ]
+        self._enqueue(reqs)
+        return reqs
 
     def generate(self, prompt_ids, max_new_tokens=None, deadline_s=None,
                  timeout: Optional[float] = None) -> InferenceRequest:
@@ -309,27 +375,57 @@ class Scheduler:
                 and oldest_wait < self.max_wait_s  # prefills together
             ):
                 return
+            paged = getattr(self.engine, "kv_paging", False)
+            budget = self.engine.blocks_available() if paged else 0
             batch, slots = [], []
             while self._queue and self._free:
+                if paged:
+                    head = self._queue[0]
+                    need = self.engine.projected_blocks(
+                        head.prompt_ids, head.max_new_tokens
+                    )
+                    if need > budget:
+                        break  # FIFO head waits until decodes free blocks
+                    budget -= need
                 batch.append(self._queue.popleft())
                 slots.append(self._free.pop())
+            if not batch:
+                return
             self.metrics.set_gauge("queue_depth", len(self._queue))
         t0 = time.perf_counter()
-        self.engine.insert_requests(
-            [(r.prompt_ids, r.max_new_tokens) for r in batch], slots
-        )
+        try:
+            self.engine.insert_requests(
+                [(r.prompt_ids, r.max_new_tokens) for r in batch], slots
+            )
+        except KVPoolExhaustedError:
+            # projection raced block state (e.g. an idle cached block the
+            # probe counted as shared got evicted mid-placement); the
+            # engine rolled the whole call back — requeue in order and
+            # retry once blocks free
+            with self._cond:
+                self._queue.extendleft(reversed(batch))
+                self._free.extend(slots)
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+            return
         self.metrics.observe("prefill_latency_seconds", time.perf_counter() - t0)
         self.metrics.inc("prefill_batches_total")
         with self._cond:
             for req, slot in zip(batch, slots):
                 self._slot_req[slot] = req
             self.metrics.set_gauge("slots_active", len(self._slot_req))
+            if len(self._slot_req) > self._slots_active_peak:
+                self._slots_active_peak = len(self._slot_req)
+                self.metrics.set_gauge("slots_active_peak", self._slots_active_peak)
+        self._sync_kv_metrics()
 
     def _decode_once(self) -> None:
         t0 = time.perf_counter()
         tokens, logprobs, valid, finished = self.engine.step()
         dt = time.perf_counter() - t0
         self.metrics.observe("decode_step_latency_seconds", dt)
+        self._decode_ewma = (
+            dt if self._decode_ewma == 0.0 else 0.8 * self._decode_ewma + 0.2 * dt
+        )
         # normalize the plain program's [P] outputs to the speculative
         # program's [P, K] layout — one loop body serves both; plain mode
         # is just K == 1
@@ -357,6 +453,7 @@ class Scheduler:
             if finished[slot]:
                 last = req.token_ids[-1] if req.token_ids else -1
                 reason = "eos" if last == eos else "length"
+                self.engine.reclaim_slots([slot])
                 self._release(slot)
                 self._finish_request(req, reason)
             elif req.deadline and now > req.deadline:
@@ -365,6 +462,24 @@ class Scheduler:
                 self._finish_request(req, "deadline")
         self.metrics.add("tokens_generated_total", emitted)
         self.metrics.record_token_rate(emitted, dt)
+        self._sync_kv_metrics()
+
+    def _sync_kv_metrics(self) -> None:
+        """Mirror the engine's block-pool tallies into the Prometheus
+        registry (gauges for occupancy, absolute-synced counters for the
+        prefix cache — the pool is the source of truth)."""
+        stats = self.engine.kv_stats() if hasattr(self.engine, "kv_stats") else {}
+        if not stats:
+            return
+        for name in (
+            "kv_blocks_total", "kv_blocks_free", "kv_blocks_used",
+            "kv_pool_bytes", "prefix_cache_idle_blocks",
+        ):
+            self.metrics.set_gauge(name, stats[name])
+        for name in (
+            "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
+        ):
+            self.metrics.set_counter(name, stats[name])
 
     def _release(self, slot: int) -> None:
         with self._cond:

@@ -229,32 +229,63 @@ class InferenceServer:
         else:
             raise ValueError("payload needs 'prompt' or 'prompt_ids'")
         unsupported = set(payload) - {
-            "prompt", "prompt_ids", "max_new_tokens", "deadline_s"
+            "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n"
         }
         if unsupported:
             raise ValueError(
                 f"unsupported request keys {sorted(unsupported)}; sampling "
                 "knobs are fixed at server start (inference.gen_kwargs)"
             )
-        req = self.scheduler.submit(
-            ids,
-            max_new_tokens=payload.get("max_new_tokens"),
-            deadline_s=payload.get("deadline_s"),
-        )
-        req.wait()
-        out = {
-            "id": req.id,
-            "token_ids": req.token_ids,
-            "token_logprobs": req.token_logprobs,
-            "finish_reason": req.finish_reason,
-            "latency_s": req.latency_s,
-            # which weights produced this rollout — routers enforce the
-            # staleness bound per-reply, not just per-probe
-            "checkpoint_step": self._effective_checkpoint_step(),
+        n = int(payload.get("n", 1))
+        if n == 1:
+            reqs = [self.scheduler.submit(
+                ids,
+                max_new_tokens=payload.get("max_new_tokens"),
+                deadline_s=payload.get("deadline_s"),
+            )]
+        else:
+            # GRPO-style fan-out: one prompt, n independent completions —
+            # enqueued adjacently so a paged engine shares the prompt's
+            # KV blocks across the whole group (one full prefill)
+            reqs = self.scheduler.submit_n(
+                ids, n,
+                max_new_tokens=payload.get("max_new_tokens"),
+                deadline_s=payload.get("deadline_s"),
+            )
+        for req in reqs:
+            req.wait()
+        step = self._effective_checkpoint_step()
+
+        def seq(req):
+            out = {
+                "id": req.id,
+                "token_ids": req.token_ids,
+                "token_logprobs": req.token_logprobs,
+                "finish_reason": req.finish_reason,
+                "latency_s": req.latency_s,
+                # which weights produced this rollout — routers enforce
+                # the staleness bound per-reply, not just per-probe
+                "checkpoint_step": step,
+            }
+            if self.tokenizer is not None:
+                out["text"] = self.tokenizer.decode(req.token_ids)
+            return out
+
+        if n == 1:
+            return seq(reqs[0])
+        reasons = [r.finish_reason for r in reqs]
+        if "shutdown" in reasons:
+            worst = "shutdown"
+        elif "deadline" in reasons:
+            worst = "deadline"
+        else:
+            worst = reasons[0]
+        return {
+            "n": n,
+            "sequences": [seq(r) for r in reqs],
+            "finish_reason": worst,
+            "checkpoint_step": step,
         }
-        if self.tokenizer is not None:
-            out["text"] = self.tokenizer.decode(req.token_ids)
-        return out
 
     # ------------------------------------------------------------------
     # Admin surface (fleet supervisor orchestration)
@@ -414,6 +445,10 @@ class InferenceServer:
                         return
                     watcher = server.watcher
                     ready = server.ready
+                    kv = (
+                        server.engine.kv_stats()
+                        if hasattr(server.engine, "kv_stats") else {}
+                    )
                     self._reply_json(200, {
                         # liveness ("process is up") vs readiness ("can
                         # take traffic now") — a reload in flight is live
@@ -429,6 +464,9 @@ class InferenceServer:
                         "param_version": server.engine.param_version,
                         "checkpoint_step": server._effective_checkpoint_step(),
                         "reloads": watcher.reloads,
+                        # paged-pool occupancy (empty dict when paging is
+                        # off) — supervisors surface these per-replica
+                        **({"kv": kv} if kv else {}),
                     })
                     return
                 self.send_error(404)

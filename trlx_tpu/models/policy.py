@@ -211,19 +211,26 @@ class CausalLMWithValueHead(nn.Module):
         trlx_tpu/inference/engine.py). Returns (logits, new_cache)."""
         return self.lm.decode_step_rows(tokens, cache, token_mask)
 
+    def prefill_rows(self, tokens, cache, token_mask):
+        """Per-row-offset multi-token prefill (the paged engine's insert
+        path). Returns (logits, new_cache)."""
+        return self.lm.prefill_rows(tokens, cache, token_mask)
+
     def spec_draft_step(self, tokens, cache, token_mask, split: int):
         """Trunk-only per-row draft step (self-speculative decode). Returns
         (h_split, h_norm, new_cache) — no heads run during drafting."""
         return self.lm.spec_draft_step(tokens, cache, token_mask, split)
 
     def spec_verify_rows(self, h, cache, row_start, positions, split: int,
-                         with_value: bool = False):
+                         with_value: bool = False, token_mask=None):
         """Batched suffix verify from the trunk's own h_split rows. Returns
         (logits, values | None, new_layers); values come from the MLP head
         on h_final (the deeper value branch is computed in the scoring
-        pass, same restriction as decode_step's per-step values)."""
+        pass, same restriction as decode_step's per-step values).
+        `token_mask` gates paged-arena cache writes (see
+        TransformerLM.spec_verify_rows); dense caches ignore it."""
         logits, h_final, new_layers = self.lm.spec_verify_rows(
-            h, cache, row_start, positions, split
+            h, cache, row_start, positions, split, token_mask=token_mask
         )
         values = None
         if with_value:
@@ -263,6 +270,10 @@ class CausalLMWithILQLHeads(nn.Module):
         Plain-LM logits only — the ILQL advantage shift is a training-time
         sampler feature; serve ILQL policies with the static engine."""
         return self.lm.decode_step_rows(tokens, cache, token_mask)
+
+    def prefill_rows(self, tokens, cache, token_mask):
+        """Per-row-offset multi-token prefill (paged engine insert)."""
+        return self.lm.prefill_rows(tokens, cache, token_mask)
 
 
 # ---------------------------------------------------------------------------

@@ -279,7 +279,65 @@ class Attention(nn.Module):
             k = apply_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
 
         new_cache = None
-        if layer_cache is not None:
+        if layer_cache is not None and "table" in layer_cache:
+            # Paged KV pool (inference/engine.py, kv_paging): the layer
+            # cache is a global block arena k/v [n_blocks, block_size,
+            # nkv, hd] shared by every slot plus a per-row block table
+            # [b, n_tbl] mapping logical token columns to physical
+            # blocks. This step's K/V scatters to per-row columns
+            # [cache_index, cache_index + t); positions with
+            # attn_mask == 0 (right-pad, inactive slots) are redirected
+            # to block index n_blocks, which the jitted scatter DROPS —
+            # they never touch the arena, so stale block tables on freed
+            # rows are harmless. The read side gathers the row's blocks
+            # back into the dense [b, n_tbl*block_size, nkv, hd] layout
+            # and falls through to the same einsum as the fixed pool;
+            # int8 arenas carry per-token-per-head f32 scale planes and
+            # dequantize on the gather.
+            from trlx_tpu.ops import quant
+
+            table = layer_cache["table"]  # [b, n_tbl] int32
+            arena_k, arena_v = layer_cache["k"], layer_cache["v"]
+            n_blocks, blk_sz = arena_k.shape[0], arena_k.shape[1]
+            n_tbl = table.shape[1]
+            idx = cache_index if jnp.ndim(cache_index) == 1 else jnp.full(
+                (b,), cache_index, jnp.int32
+            )
+            cols = idx[:, None] + jnp.arange(t)[None, :]  # [b, t]
+            blk = jnp.clip(cols // blk_sz, 0, n_tbl - 1)
+            phys = jnp.take_along_axis(table, blk, axis=1)  # [b, t]
+            off = cols % blk_sz
+            if attn_mask is not None:
+                phys = jnp.where(attn_mask.astype(bool), phys, n_blocks)
+            if arena_k.dtype == jnp.int8:
+                kq, ks = quant.quantize_kv(k)
+                vq, vs = quant.quantize_kv(v)
+                new_cache = {
+                    "k": arena_k.at[phys, off].set(kq),
+                    "v": arena_v.at[phys, off].set(vq),
+                    "k_scale": layer_cache["k_scale"].at[phys, off].set(ks),
+                    "v_scale": layer_cache["v_scale"].at[phys, off].set(vs),
+                    "table": table,
+                }
+                k = quant.dequantize_kv(
+                    new_cache["k"][table].reshape(b, n_tbl * blk_sz, nkv, hd),
+                    new_cache["k_scale"][table].reshape(b, n_tbl * blk_sz, nkv),
+                    cfg.dtype,
+                )
+                v = quant.dequantize_kv(
+                    new_cache["v"][table].reshape(b, n_tbl * blk_sz, nkv, hd),
+                    new_cache["v_scale"][table].reshape(b, n_tbl * blk_sz, nkv),
+                    cfg.dtype,
+                )
+            else:
+                new_cache = {
+                    "k": arena_k.at[phys, off].set(k.astype(arena_k.dtype)),
+                    "v": arena_v.at[phys, off].set(v.astype(arena_v.dtype)),
+                    "table": table,
+                }
+                k = new_cache["k"][table].reshape(b, n_tbl * blk_sz, nkv, hd)
+                v = new_cache["v"][table].reshape(b, n_tbl * blk_sz, nkv, hd)
+        elif layer_cache is not None:
             # Write this step's K/V into the cache at cache_index, then attend
             # over the whole (static-length) cache. cache_index is a scalar
             # (every row at the same decode depth — the training sampler) or
@@ -933,15 +991,78 @@ class TransformerLM(nn.Module):
         if self.cfg.sliding_window is not None:
             bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
         h = self.embed(tokens, positions)
+        # attn_mask gates PAGED arena writes (inactive rows scatter out of
+        # bounds and are dropped); the dense cached path never reads it,
+        # so fixed-pool graphs are unchanged
         h, new_layers = self.run_blocks(
             h, bias, positions, 0, self.cfg.n_layers,
-            cache=cache["layers"], cache_index=row_index,
+            cache=cache["layers"], cache_index=row_index, attn_mask=token_mask,
         )
         logits, _ = self.unembed(h)
         new_cache = {
             "row_index": row_index + step_valid,
             "mask": new_mask,
             "pos": cache["pos"] + step_valid,
+            "layers": new_layers,
+        }
+        return logits, new_cache
+
+    def prefill_rows(
+        self,
+        tokens: jnp.ndarray,  # [b, t] RIGHT-padded prompt (suffix) tokens
+        cache: Dict[str, Any],
+        token_mask: jnp.ndarray,  # [b, t] validity (0 = right pad)
+    ):
+        """Multi-token cached prefill where every row carries its OWN write
+        offset (`cache["row_index"]`, [b]) — the paged engine's insert
+        path. Row r's valid tokens occupy cache columns
+        [row_index_r, row_index_r + len_r); a nonzero row_index means the
+        row resumes behind a shared prefix already resident in the cache
+        (prefix-cache hit), whose mask bits the caller seeds. Queries see
+        every valid cache column plus the causal prefix of their own
+        freshly-written span — the same within-block correction
+        `decode_step` applies at prefill, with per-row offsets like
+        `spec_verify_rows`. Right-pad positions write nothing the model
+        can see: their mask bit is 0 (exactly-zero attention weight) and
+        paged arena writes are dropped via `attn_mask`. Per-row values are
+        bit-identical to a left-padded `decode_step` prefill of the same
+        tokens — masked columns contribute exactly 0.0 to every softmax
+        sum regardless of where they sit. Returns (logits, new_cache)."""
+        if self.cfg.prompt_tokens > 0 or self.cfg.prefix_tokens > 0:
+            raise NotImplementedError(
+                "slot-pool prefill under prompt/prefix tuning is unsupported"
+            )
+        b, t = tokens.shape
+        row_index = cache["row_index"]
+        lens = token_mask.sum(-1).astype(jnp.int32)
+        positions = cache["pos"][:, None] + position_ids(token_mask)
+        S = cache["mask"].shape[-1]
+        cols = row_index[:, None] + jnp.arange(t)[None, :]  # [b, t]
+        # pad columns land on already-zero cells (or clip to S-1, also
+        # zero until decode begins), so the scatter of their 0 is a no-op
+        new_mask = cache["mask"].at[
+            jnp.arange(b)[:, None], jnp.clip(cols, 0, S - 1)
+        ].set(token_mask.astype(cache["mask"].dtype))
+        bias = decode_bias(new_mask, t)
+        if self.cfg.alibi:
+            bias = bias + alibi_bias(new_mask, self.cfg.n_heads)
+        if self.cfg.sliding_window is not None:
+            bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
+        q_ids = jnp.arange(t)[None, :, None]
+        k_ids = jnp.arange(S)[None, None, :]
+        start = row_index[:, None, None]
+        within = (k_ids >= start) & (k_ids - start > q_ids)  # [b, t, S]
+        bias = bias + jnp.where(within[:, None], -1e9, 0.0).astype(jnp.float32)
+        h = self.embed(tokens, positions)
+        h, new_layers = self.run_blocks(
+            h, bias, positions, 0, self.cfg.n_layers,
+            cache=cache["layers"], cache_index=row_index, attn_mask=token_mask,
+        )
+        logits, _ = self.unembed(h)
+        new_cache = {
+            "row_index": row_index + lens,
+            "mask": new_mask,
+            "pos": cache["pos"] + lens,
             "layers": new_layers,
         }
         return logits, new_cache
@@ -983,7 +1104,8 @@ class TransformerLM(nn.Module):
             bias = bias + window_bias(positions, new_mask, self.cfg.sliding_window)
         h = self.embed(tokens, positions)
         h, trunk_layers = self.run_blocks(
-            h, bias, positions, 0, split, cache=cache["layers"], cache_index=row_index,
+            h, bias, positions, 0, split, cache=cache["layers"],
+            cache_index=row_index, attn_mask=token_mask,
         )
         new_cache = {
             "row_index": row_index + step_valid,
@@ -1000,6 +1122,7 @@ class TransformerLM(nn.Module):
         row_start: jnp.ndarray,  # [b] cache offset of h's first position
         positions: jnp.ndarray,  # [b, t]
         split: int,
+        token_mask: Optional[jnp.ndarray] = None,  # [b, t] write validity
     ):
         """Batched suffix verify for self-speculative decode: resume blocks
         [split, n_layers) from the trunk's own h_split rows (the
@@ -1029,7 +1152,7 @@ class TransformerLM(nn.Module):
         bias = bias + jnp.where(within[:, None], -1e9, 0.0).astype(jnp.float32)
         h, suffix_layers = self.run_blocks(
             h, bias, positions_f, split, self.cfg.n_layers,
-            cache=cache["layers"], cache_index=row_start,
+            cache=cache["layers"], cache_index=row_start, attn_mask=token_mask,
         )
         logits, h_final = self.unembed(h)
         return logits, h_final, cache["layers"][:split] + suffix_layers
@@ -1061,6 +1184,34 @@ def init_kv_cache(cfg: TransformerConfig, batch_size: int, max_len: int, dtype=N
         "pos": jnp.zeros((batch_size,), dtype=jnp.int32),
         "layers": layers,
     }
+
+
+def init_paged_kv_arena(
+    cfg: TransformerConfig, num_blocks: int, block_size: int, dtype=None
+):
+    """Allocate the per-layer paged KV arenas: `num_blocks` blocks of
+    `block_size` token columns each, shared by every slot through per-row
+    block tables (Attention's paged branch). Block 0 is reserved by the
+    engine as a permanent zero block backing padding table entries, so it
+    is never allocated to a request. int8 arenas carry f32 scale planes
+    (per token per kv head, ops/quant.quantize_kv)."""
+    dtype = dtype or cfg.dtype
+    if getattr(cfg, "prompt_tokens", 0) or getattr(cfg, "prefix_tokens", 0):
+        raise NotImplementedError(
+            "paged KV cache under prompt/prefix tuning is unsupported"
+        )
+    shape = (num_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    layers = []
+    for _ in range(cfg.n_layers):
+        layer = {
+            "k": jnp.zeros(shape, dtype=dtype),
+            "v": jnp.zeros(shape, dtype=dtype),
+        }
+        if dtype == jnp.int8:
+            layer["k_scale"] = jnp.zeros(shape[:3], dtype=jnp.float32)
+            layer["v_scale"] = jnp.zeros(shape[:3], dtype=jnp.float32)
+        layers.append(layer)
+    return layers
 
 
 # ---------------------------------------------------------------------------

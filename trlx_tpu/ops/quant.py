@@ -129,6 +129,25 @@ def quantize_frozen_flat(frozen_flat: Dict, split: int) -> Dict:
     return out
 
 
+def quantize_kv(x: jnp.ndarray):
+    """Symmetric per-token-per-head int8 for KV-cache blocks: the scale
+    axis is the HEAD dim (last), so each written token keeps its own f32
+    scale per kv head — the finest granularity the paged arena can store
+    without widening the block table. Returns (q int8 [..., hd],
+    scale f32 [...])."""
+    x32 = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(x32), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of `quantize_kv`, applied in-kernel on the attention read
+    (XLA fuses the convert+mul into the gather's consumer)."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def quantized_bytes(params: Any) -> int:
     """HBM bytes of the decode view (int8 q + f32 scales + dense rest) —
     reported by bench.py's roofline accounting."""

@@ -461,6 +461,12 @@ class TPUTrainer(BaseRLTrainer):
             max_prefill_batch=icfg.max_prefill_batch,
             prompt_bucket=icfg.prompt_bucket,
             seed=self.config.train.seed,
+            kv_paging=icfg.kv_paging,
+            kv_block_size=icfg.kv_block_size,
+            kv_pool_blocks=icfg.kv_pool_blocks,
+            kv_cache_dtype=icfg.kv_cache_dtype,
+            prefix_cache=icfg.prefix_cache,
+            prefix_cache_capacity=icfg.prefix_cache_capacity,
         )
         scheduler = Scheduler(
             engine,

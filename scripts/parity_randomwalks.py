@@ -55,13 +55,111 @@ SEED = 1000
 EVAL_REPEATS = 8  # each unique start node appears 8x in eval_prompts
 
 
+def _generate_random_walks_local(seed=1002, n_nodes=21, max_length=10,
+                                 n_walks=1000, p_edge=0.1):
+    """Faithful numpy-only reimplementation of the reference generator
+    (examples/randomwalks/randomwalks.py) for hosts without /root/reference.
+    It issues the SAME RandomState call sequence under the same seed
+    (rng.rand(n,n) for the graph, then rng.choice per walk step), so it
+    reproduces the reference's exact graph, sample walks and eval prompts;
+    shortest paths use BFS instead of networkx (no rng consumed). Used by
+    the ours-* stages only — the ref-* stages import the real trlx and
+    cannot run without /root/reference anyway."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    while True:
+        adj = rng.rand(n_nodes, n_nodes) > (1 - p_edge)
+        np.fill_diagonal(adj, 0)
+        if np.all(adj.sum(1)):
+            break
+    # terminal state
+    adj[0, :] = 0
+    adj[0, 0] = 1
+
+    char_to_node = {chr(ix + ord("a")): ix for ix in range(n_nodes)}
+    node_to_char = {ix: chr(ix + ord("a")) for ix in range(n_nodes)}
+
+    goal = 0
+    sample_walks = []
+    for _ in range(n_walks):
+        node = rng.choice(n_nodes)
+        walk = [node]
+        while node != goal and len(walk) < max_length:
+            node = rng.choice(np.nonzero(adj[node])[0])
+            walk.append(node)
+        sample_walks.append("".join(node_to_char[ix] for ix in walk))
+
+    # BFS shortest-path node counts to the goal, truncated at max_length
+    # (the reference truncates the networkx path the same way)
+    from collections import deque
+
+    shortest_lengths = []
+    for start in range(1, n_nodes):
+        dist = {start: 1}
+        q = deque([start])
+        found = None
+        while q:
+            u = q.popleft()
+            if u == goal:
+                found = dist[u]
+                break
+            for v in np.nonzero(adj[u])[0]:
+                v = int(v)
+                if v not in dist:
+                    dist[v] = dist[u] + 1
+                    q.append(v)
+        shortest_lengths.append(min(found, max_length) if found else max_length)
+    shortest_lengths = np.asarray(shortest_lengths, dtype=np.float64)
+
+    def metric_fn(samples, **kwargs):
+        infty = 100
+        lengths, ref_lengths = [], []
+        for s in samples:
+            s = s[:max_length]
+            if not s or s[0] not in char_to_node:
+                lengths.append(infty)
+                ref_lengths.append(float(max_length))
+                continue
+            for ix in range(len(s)):
+                node = char_to_node.get(s[ix], 1000)
+                if node >= n_nodes:
+                    lengths.append(infty)
+                    break
+                if ix > 0 and not adj[char_to_node[s[ix - 1]], node]:
+                    lengths.append(infty)
+                    break
+                if node == goal:
+                    lengths.append(ix + 1)
+                    break
+            else:
+                lengths.append(infty)
+            # reference quirk preserved: start node's shortest length is
+            # indexed at char-1 (start 'a' == the goal wraps to the last)
+            ref_lengths.append(float(shortest_lengths[char_to_node[s[0]] - 1]))
+        lengths = np.asarray(lengths, dtype=np.float64)
+        bound = np.where(lengths == infty, max_length, lengths)
+        ref = np.asarray(ref_lengths, dtype=np.float64)
+        return {
+            "lengths": lengths,
+            "optimality": (max_length - bound) / (max_length - ref),
+        }
+
+    eval_prompts = sorted(char_to_node.keys())
+    return metric_fn, eval_prompts, sample_walks
+
+
 def load_reference_task(seed=1002):
     """Import the reference's own task generator by file path (package names
-    collide with ours); returns (metric_fn, eval_prompts, walks)."""
-    spec = importlib.util.spec_from_file_location(
-        "ref_randomwalks",
-        os.path.join(REFERENCE, "examples", "randomwalks", "randomwalks.py"),
-    )
+    collide with ours); returns (metric_fn, eval_prompts, walks). Falls back
+    to the bit-identical local reimplementation when /root/reference is
+    absent (the ours-* stages only need the task, not the reference trlx)."""
+    gen = os.path.join(REFERENCE, "examples", "randomwalks", "randomwalks.py")
+    if not os.path.exists(gen):
+        print(f"[task] {gen} not found; using the local seed-identical "
+              "randomwalks reimplementation")
+        return _generate_random_walks_local(seed=seed)
+    spec = importlib.util.spec_from_file_location("ref_randomwalks", gen)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     metric_fn, eval_prompts, walks, _logit_mask = mod.generate_random_walks(seed=seed)
@@ -351,6 +449,74 @@ def cmd_ours_ppo(args):
         config=config,
     )
     print(f"[ours-ppo] wrote {rec.path}: {rec.n_eval_calls} evals, "
+          f"{rec.n_reward_calls} reward calls")
+
+
+# Critic-free GRPO on the same task, same budget as the critic-full PPO row
+# (64 outer iterations, 128 rollouts/iter, 4 inner epochs, lr 3e-4). The
+# comparison baseline is OUR PPO curve (there is no reference GRPO trainer),
+# so this row is a within-framework claim: dropping the value head keeps
+# >= 90% of PPO's final reward on the same budget.
+GRPO_EPOCHS_OUTER = PPO_EPOCHS_OUTER
+GRPO_GROUP_SIZE = 8  # 16 prompts x 8 completions per 128-sample chunk
+
+
+def _ours_grpo_config():
+    from trlx_tpu.data.configs import (
+        ModelConfig, OptimizerConfig, ParallelConfig, SchedulerConfig,
+        TokenizerConfig, TrainConfig, TRLConfig,
+    )
+    from trlx_tpu.trainer.grpo_trainer import GRPOConfig
+
+    return TRLConfig(
+        train=TrainConfig(
+            seq_length=10, epochs=GRPO_EPOCHS_OUTER, total_steps=100000,
+            batch_size=100, checkpoint_interval=10**8,
+            eval_interval=PPO_EVAL_INTERVAL,
+            pipeline="PromptPipeline", trainer="GRPOTrainer",
+            checkpoint_dir=os.path.join(WORKDIR, "ours_grpo_ckpt"),
+            tracker=None, seed=SEED, save_best=False,
+        ),
+        model=ModelConfig(model_path=CKPT, num_layers_unfrozen=-1),
+        tokenizer=TokenizerConfig(tokenizer_path=f"char:{ALPHABET}",
+                                  truncation_side="right"),
+        optimizer=OptimizerConfig(
+            name="adamw",
+            kwargs=dict(lr=3.0e-4, betas=(0.9, 0.95), eps=1.0e-8, weight_decay=1.0e-6),
+        ),
+        scheduler=SchedulerConfig(
+            name="cosine_annealing", kwargs=dict(T_max=10000, eta_min=3.0e-4)
+        ),
+        method=GRPOConfig(
+            name="GRPOConfig", num_rollouts=128, chunk_size=128, ppo_epochs=4,
+            group_size=GRPO_GROUP_SIZE, advantage_mode="grpo",
+            # the PPO row runs its example's init_kl_coef=0; keep the
+            # in-loss reference KL barely-on so the k3 term is exercised
+            # without handicapping the comparison
+            grpo_kl_coef=0.001, init_kl_coef=0,
+            target=None, horizon=10000, cliprange=0.2,
+            scale_reward=None, ref_mean=None, ref_std=None, cliprange_reward=1,
+            gen_kwargs=dict(max_new_tokens=9, top_k=0, top_p=1.0, do_sample=True),
+        ),
+        parallel=ParallelConfig(),
+    )
+
+
+def cmd_ours_grpo(args):
+    sys.path.insert(0, REPO)
+    import trlx_tpu as trlx
+
+    metric_fn, eval_prompts, _walks = load_reference_task()
+    rec = CurveRecorder(os.path.join(WORKDIR, "ours_grpo.curve.jsonl"), metric_fn)
+    config = _ours_grpo_config()
+    trlx.train(
+        reward_fn=rec.reward_fn,
+        prompts=sorted(eval_prompts),
+        eval_prompts=eval_prompt_list(eval_prompts),
+        metric_fn=rec.metric_fn,
+        config=config,
+    )
+    print(f"[ours-grpo] wrote {rec.path}: {rec.n_eval_calls} evals, "
           f"{rec.n_reward_calls} reward calls")
 
 
@@ -718,10 +884,22 @@ def cmd_compare(args):
         "ppo": "PPOTrainer", "ilql": "ILQLTrainer", "sft": "SFTTrainer",
         "rft": "RFTTrainer", "ppo_dense": "PPOTrainer (dense rewards)",
     }
+    dest = os.path.join(REPO, "PARITY_CURVES.json")
+    committed_doc = {}
+    if os.path.exists(dest):
+        with open(dest) as f:
+            committed_doc = json.load(f)
+    committed = committed_doc.get("methods", {})
     for method in ("ppo", "ilql", "sft", "rft", "ppo_dense"):
         ref_path = os.path.join(WORKDIR, f"ref_{method}.curve.jsonl")
         ours_path = os.path.join(WORKDIR, f"ours_{method}.curve.jsonl")
         if not (os.path.exists(ref_path) and os.path.exists(ours_path)):
+            if method in committed:
+                # partial regeneration (e.g. `all --only ours-grpo`): carry
+                # the committed entry forward rather than dropping it
+                print(f"[compare] keeping committed entry for {method}")
+                out["methods"][method] = committed[method]
+                continue
             if method in ("ppo", "ilql"):
                 # the core rows: refuse rather than clobber the committed
                 # artifact with an empty comparison
@@ -758,7 +936,52 @@ def cmd_compare(args):
               f"delta last-q {entry['delta_mean_last_quarter']:+.3f}")
         if entry["delta_mean_last_quarter"] < -0.05:
             ok = False
-    dest = os.path.join(REPO, "PARITY_CURVES.json")
+
+    # GRPO row: critic-free vs OUR critic-full PPO on the same task/budget.
+    # The "reference" side is our PPO curve (no reference GRPO trainer
+    # exists); acceptance is >= 90% of PPO's last-quarter mean optimality.
+    grpo_path = os.path.join(WORKDIR, "ours_grpo.curve.jsonl")
+    if os.path.exists(grpo_path):
+        base = out["methods"].get("ppo")
+        if base is None:
+            print("[compare] skipping grpo: no PPO baseline to compare against")
+        else:
+            baseline = dict(base["ours"])
+            baseline["trainer"] = "PPOTrainer (ours, critic-full baseline)"
+            grpo_evals, grpo_rewards = _load_curve(grpo_path)
+            gs = _summary(grpo_evals)
+            ratio = gs["mean_last_quarter"] / max(baseline["mean_last_quarter"], 1e-9)
+            entry = {
+                "reference": baseline,
+                "ours": {"trainer": "GRPOTrainer (critic-free, group_size=%d)"
+                                    % GRPO_GROUP_SIZE,
+                         "eval_curve": [round(v, 4) for v in grpo_evals],
+                         "reward_curve": [[n, round(v, 4)] for n, v in grpo_rewards],
+                         **{k: round(v, 4) if isinstance(v, float) else v
+                            for k, v in gs.items()}},
+                "delta_final": round(gs["final"] - baseline["final"], 4),
+                "delta_mean_last_quarter": round(
+                    gs["mean_last_quarter"] - baseline["mean_last_quarter"], 4),
+                "ratio_last_quarter_vs_ppo": round(ratio, 4),
+            }
+            out["methods"]["grpo"] = entry
+            out["config"]["grpo"] = (
+                "ppo hparams minus the value function (GRPOTrainer, "
+                f"group_size={GRPO_GROUP_SIZE}, advantage_mode=grpo, "
+                f"grpo_kl_coef=0.001), epochs={GRPO_EPOCHS_OUTER}; baseline "
+                "side = our PPO curve (within-framework critic-free claim)"
+            )
+            print(f"[compare] grpo: ppo-baseline last-q "
+                  f"{baseline['mean_last_quarter']:.3f} | grpo last-q "
+                  f"{gs['mean_last_quarter']:.3f} | ratio {ratio:.3f}")
+            if ratio < 0.9:
+                ok = False
+    elif "grpo" in committed:
+        print("[compare] keeping committed entry for grpo")
+        out["methods"]["grpo"] = committed["grpo"]
+        if "grpo" in committed_doc.get("config", {}):
+            out["config"]["grpo"] = committed_doc["config"]["grpo"]
+
     with open(dest, "w") as f:
         json.dump(out, f, indent=2)
     print(f"[compare] wrote {dest}; parity {'OK' if ok else 'FAILED'}")
@@ -806,6 +1029,7 @@ def cmd_all(args):
         ("ref-sft", ref_env), ("ours-sft", ours_env),
         ("ref-rft", ref_env), ("ours-rft", ours_env),
         ("ref-ppo-dense", ref_env), ("ours-ppo-dense", ours_env),
+        ("ours-grpo", ours_env),
     ):
         if args.only and stage not in args.only:
             continue
@@ -818,7 +1042,7 @@ def main():
     parser.add_argument("stage", choices=[
         "prepare", "ref-ppo", "ours-ppo", "ref-ilql", "ours-ilql",
         "ref-sft", "ours-sft", "ref-rft", "ours-rft",
-        "ref-ppo-dense", "ours-ppo-dense",
+        "ref-ppo-dense", "ours-ppo-dense", "ours-grpo",
         "compare", "all",
     ])
     parser.add_argument("--warm-steps", type=int, default=100)
@@ -833,6 +1057,7 @@ def main():
         "ref-sft": cmd_ref_sft, "ours-sft": cmd_ours_sft,
         "ref-rft": cmd_ref_rft, "ours-rft": cmd_ours_rft,
         "ref-ppo-dense": cmd_ref_ppo_dense, "ours-ppo-dense": cmd_ours_ppo_dense,
+        "ours-grpo": cmd_ours_grpo,
         "compare": cmd_compare, "all": cmd_all,
     }[args.stage]
     rc = cmd(args)

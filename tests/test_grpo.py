@@ -1,0 +1,315 @@
+"""Critic-free RL (GRPO/RLOO) coverage: group-relative advantage math
+against hand-computed examples, grpo_loss mask correctness on padded rows,
+group-id collation through the rollout store, the no-value-head parameter
+tree, and the warn-and-refuse behavior of the pipelined / sequence-parallel
+trainers when handed a critic-free method section.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data import PPORLElement
+from trlx_tpu.data.default_configs import default_grpo_config
+from trlx_tpu.ops.ppo import group_relative_advantages, grpo_loss
+from trlx_tpu.pipeline.ppo_pipeline import PPORolloutStorage
+
+# ---------------------------------------------------------------------------
+# group_relative_advantages: hand-computed 2-prompt x 3-completion example
+# ---------------------------------------------------------------------------
+
+# rewards[g, i]: prompt group g, completion i
+REWARDS_2x3 = np.array([[1.0, 2.0, 3.0], [5.0, 5.0, 8.0]], dtype=np.float32)
+
+
+def test_grpo_advantages_match_hand_computation():
+    adv = np.asarray(group_relative_advantages(jnp.asarray(REWARDS_2x3), mode="grpo"))
+    eps = 1e-4
+    # group 0: mean 2, population std sqrt(2/3)
+    s0 = np.sqrt(2.0 / 3.0)
+    # group 1: mean 6, std sqrt((1 + 1 + 4) / 3)
+    s1 = np.sqrt(2.0)
+    expected = np.array(
+        [
+            [(1 - 2) / (s0 + eps), 0.0, (3 - 2) / (s0 + eps)],
+            [(5 - 6) / (s1 + eps), (5 - 6) / (s1 + eps), (8 - 6) / (s1 + eps)],
+        ],
+        dtype=np.float32,
+    )
+    np.testing.assert_allclose(adv, expected, rtol=1e-5, atol=1e-6)
+    # normalization is per group, not pooled: group means are ~0 individually
+    np.testing.assert_allclose(adv.mean(axis=-1), 0.0, atol=1e-5)
+
+
+def test_rloo_advantages_match_hand_computation():
+    adv = np.asarray(group_relative_advantages(jnp.asarray(REWARDS_2x3), mode="rloo"))
+    # A_i = r_i - mean(others) = (G*r_i - sum) / (G - 1), G = 3
+    expected = np.array(
+        [[-1.5, 0.0, 1.5], [-1.5, -1.5, 3.0]], dtype=np.float32
+    )
+    np.testing.assert_allclose(adv, expected, rtol=1e-6)
+
+
+def test_degenerate_group_all_equal_rewards_is_zero_not_nan():
+    same = jnp.full((2, 4), 7.0)
+    for mode in ("grpo", "rloo"):
+        adv = np.asarray(group_relative_advantages(same, mode=mode))
+        assert np.all(np.isfinite(adv)), mode
+        np.testing.assert_allclose(adv, 0.0, atol=1e-6)
+
+
+def test_rloo_single_completion_degrades_to_raw_reward():
+    r = jnp.asarray([[2.5], [-1.0]])
+    adv = np.asarray(group_relative_advantages(r, mode="rloo"))
+    np.testing.assert_allclose(adv, np.asarray(r))
+
+
+def test_unknown_advantage_mode_raises():
+    with pytest.raises(ValueError, match="advantage_mode"):
+        group_relative_advantages(jnp.ones((1, 2)), mode="vtrace")
+
+
+# ---------------------------------------------------------------------------
+# grpo_loss: hand-computed value + padded-row mask correctness
+# ---------------------------------------------------------------------------
+
+
+def test_grpo_loss_matches_hand_computation():
+    logprobs = jnp.asarray([[-1.0, -2.0]])
+    old_logprobs = jnp.asarray([[-1.0, -2.0]])  # ratio == 1, no clipping
+    ref_logprobs = jnp.asarray([[-1.5, -2.5]])
+    advantages = jnp.asarray([[1.0, 0.5]])
+    mask = jnp.ones((1, 2))
+    kl_coef = 0.1
+
+    loss, stats = grpo_loss(
+        logprobs, old_logprobs, ref_logprobs, advantages, mask,
+        cliprange=0.2, kl_coef=kl_coef,
+    )
+    # pg term: ratio == 1 so both branches equal -A; mean over 2 tokens
+    pg = -(1.0 + 0.5) / 2.0
+    # k3 KL to reference: ref - pi = -0.5 per token
+    k3 = np.exp(-0.5) - (-0.5) - 1.0
+    expected = pg + kl_coef * k3
+    assert np.isclose(float(loss), expected, rtol=1e-5)
+    assert np.isclose(float(stats["losses"]["policy_loss"]), pg, rtol=1e-5)
+    assert np.isclose(float(stats["losses"]["kl_loss"]), k3, rtol=1e-5)
+    assert float(stats["policy"]["clipfrac"]) == 0.0
+
+
+def test_grpo_loss_clips_large_ratios():
+    # ratio = e^1 ~ 2.718 with positive advantage -> clipped at 1 + 0.2
+    logprobs = jnp.asarray([[0.0]])
+    old_logprobs = jnp.asarray([[-1.0]])
+    ref_logprobs = jnp.asarray([[0.0]])  # no KL contribution
+    advantages = jnp.asarray([[2.0]])
+    mask = jnp.ones((1, 1))
+    loss, stats = grpo_loss(
+        logprobs, old_logprobs, ref_logprobs, advantages, mask,
+        cliprange=0.2, kl_coef=0.0,
+    )
+    assert np.isclose(float(loss), -2.0 * 1.2, rtol=1e-5)
+    assert float(stats["policy"]["clipfrac"]) == 1.0
+
+
+def test_grpo_loss_masks_padded_rows():
+    """A fully masked row full of junk must not move the loss, and padded
+    tail positions on a live row must not either."""
+    logprobs = jnp.asarray([[-1.0, -2.0]])
+    old = jnp.asarray([[-1.0, -2.0]])
+    ref = jnp.asarray([[-1.5, -2.5]])
+    adv = jnp.asarray([[1.0, 0.5]])
+    loss_ref, _ = grpo_loss(
+        logprobs, old, ref, adv, jnp.ones((1, 2)), cliprange=0.2, kl_coef=0.1
+    )
+
+    junk = 1e3
+    logprobs2 = jnp.concatenate([logprobs, jnp.full((1, 2), -junk)], axis=0)
+    old2 = jnp.concatenate([old, jnp.full((1, 2), junk)], axis=0)
+    ref2 = jnp.concatenate([ref, jnp.full((1, 2), junk)], axis=0)
+    adv2 = jnp.concatenate([adv, jnp.full((1, 2), junk)], axis=0)
+    mask2 = jnp.asarray([[1.0, 1.0], [0.0, 0.0]])
+    loss_masked, stats = grpo_loss(
+        logprobs2, old2, ref2, adv2, mask2, cliprange=0.2, kl_coef=0.1
+    )
+    assert np.isclose(float(loss_masked), float(loss_ref), rtol=1e-5)
+    assert np.isfinite(float(loss_masked))
+    assert np.isclose(float(stats["padding_percentage"]), 0.5)
+
+    # padded tail positions within a live row
+    logprobs3 = jnp.asarray([[-1.0, -2.0, -junk]])
+    old3 = jnp.asarray([[-1.0, -2.0, junk]])
+    ref3 = jnp.asarray([[-1.5, -2.5, junk]])
+    adv3 = jnp.asarray([[1.0, 0.5, junk]])
+    mask3 = jnp.asarray([[1.0, 1.0, 0.0]])
+    loss_tail, _ = grpo_loss(
+        logprobs3, old3, ref3, adv3, mask3, cliprange=0.2, kl_coef=0.1
+    )
+    assert np.isclose(float(loss_tail), float(loss_ref), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# group ids through the rollout store
+# ---------------------------------------------------------------------------
+
+
+def _element(group_id=None):
+    t = np.arange(4, dtype=np.int32)
+    z = np.zeros(4, dtype=np.float32)
+    return PPORLElement(
+        query_tensor=t, response_tensor=t, logprobs=z, values=z, rewards=z,
+        group_id=group_id,
+    )
+
+
+def test_rollout_store_collates_group_ids():
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([_element(group_id=g) for g in (0, 0, 1, 1)])
+    batch = next(iter(store.create_loader(4, shuffle=False)))
+    assert batch.group_ids is not None
+    np.testing.assert_array_equal(np.asarray(batch.group_ids), [0, 0, 1, 1])
+    assert np.asarray(batch.group_ids).dtype == np.int32
+
+
+def test_rollout_store_without_group_ids_collates_none():
+    store = PPORolloutStorage(pad_token_id=0)
+    store.push([_element() for _ in range(4)])
+    batch = next(iter(store.create_loader(4, shuffle=False)))
+    assert batch.group_ids is None
+
+
+# ---------------------------------------------------------------------------
+# GRPOTrainer: no value head allocated; experience is group-normalized
+# ---------------------------------------------------------------------------
+
+
+def _grpo_trainer(**method_overrides):
+    from trlx_tpu.trainer.grpo_trainer import GRPOTrainer
+
+    method = dict(
+        num_rollouts=8, chunk_size=8, ppo_epochs=1, group_size=4,
+        gen_kwargs=dict(max_new_tokens=8, do_sample=True),
+    )
+    method.update(method_overrides)
+    config = default_grpo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1),
+        train=dict(seq_length=32, batch_size=8, tracker=None),
+        method=method,
+    )
+    return GRPOTrainer(
+        config,
+        reward_fn=lambda samples, prompts, outputs, **kw: [
+            float(len(o)) + 0.1 * i for i, o in enumerate(outputs)
+        ],
+    )
+
+
+def test_grpo_trainer_allocates_no_value_head():
+    import jax
+
+    trainer = _grpo_trainer()
+    leaves = jax.tree_util.tree_leaves_with_path(trainer.params)
+    paths = ["/".join(str(k) for k in path) for path, _ in leaves]
+    assert paths, "empty parameter tree"
+    offenders = [p for p in paths if "v_head" in p or "value" in p.lower()]
+    assert not offenders, f"value-head parameters found: {offenders}"
+
+
+def test_grpo_make_experience_groups_and_trains():
+    from trlx_tpu.pipeline import MiniBatchIterator
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+
+    trainer = _grpo_trainer()
+    prompts = [f"prompt number {i}" for i in range(8)]
+    trainer.add_prompt_pipeline(
+        PromptPipeline(prompts, max_prompt_length=8, tokenizer=trainer.tokenizer)
+    )
+    trainer.make_experience(trainer.config.method.num_rollouts)
+
+    elems = trainer.store.history
+    assert len(elems) == 8
+    gids = np.asarray([e.group_id for e in elems])
+    # 8 rollouts / group_size 4 -> two groups of 4 adjacent elements
+    np.testing.assert_array_equal(np.sort(np.unique(gids)), [0, 1])
+    assert all((gids == g).sum() == 4 for g in (0, 1))
+
+    # the rewards slot carries the broadcast group advantage
+    # (init_kl_coef defaults to 0.0 in default_grpo_config); per-group the
+    # standardized advantages mean to ~0
+    for g in (0, 1):
+        group_adv = np.asarray(
+            [e.rewards[-1] for e in elems if e.group_id == g], dtype=np.float64
+        )
+        assert np.all(np.isfinite(group_adv))
+        assert abs(group_adv.mean()) < 1e-3
+        # each element's reward vector is constant across tokens (pure
+        # broadcast advantage, no per-token KL penalty at init_kl_coef=0)
+        for e in elems:
+            np.testing.assert_allclose(e.rewards, e.rewards[0], atol=1e-6)
+
+    # values slot carries finite reference logprobs (the KL anchor)
+    for e in elems:
+        assert np.all(np.isfinite(e.values))
+
+    # one inner epoch trains with a finite loss and no value-loss stat
+    dl = trainer.create_train_dataloader()
+    stats = None
+    for mb in MiniBatchIterator(dl, trainer.mb_size, trainer.num_mb):
+        stats = trainer.train_minibatch(mb)
+    assert stats is not None
+    total = float(np.asarray(stats["losses"]["total_loss"]))
+    assert np.isfinite(total)
+    assert "value_loss" not in stats["losses"]
+    assert "kl_loss" in stats["losses"]
+
+
+def test_grpo_config_validation():
+    from trlx_tpu.trainer.grpo_trainer import GRPOTrainer
+
+    with pytest.raises(ValueError, match="advantage_mode"):
+        _grpo_trainer(advantage_mode="gae")
+    with pytest.raises(ValueError, match="group_size"):
+        _grpo_trainer(group_size=0)
+    with pytest.raises(ValueError, match="group_size"):
+        _grpo_trainer(chunk_size=6, num_rollouts=6)  # not divisible by 4
+    cfg = default_grpo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=0),
+        train=dict(seq_length=32, batch_size=8, tracker=None),
+        method=dict(num_rollouts=8, chunk_size=8, group_size=4,
+                    gen_kwargs=dict(max_new_tokens=8)),
+    )
+    with pytest.raises(ValueError, match="num_layers_unfrozen"):
+        GRPOTrainer(cfg, reward_fn=lambda samples, prompts, outputs, **kw: [0.0])
+
+
+# ---------------------------------------------------------------------------
+# pipelined / sequence-parallel trainers refuse critic-free method configs
+# ---------------------------------------------------------------------------
+
+
+def _critic_free_config(**parallel):
+    return default_grpo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1),
+        train=dict(seq_length=32, batch_size=8, tracker=None),
+        method=dict(num_rollouts=8, chunk_size=8, group_size=4,
+                    gen_kwargs=dict(max_new_tokens=8)),
+        parallel=parallel,
+    )
+
+
+def test_pipelined_trainer_refuses_grpo_method():
+    from trlx_tpu.trainer.pipelined_ppo_trainer import PipelinedPPOTrainer
+
+    cfg = _critic_free_config(pipeline=2)
+    with pytest.raises(NotImplementedError, match="GRPO/RLOO"):
+        PipelinedPPOTrainer(cfg, reward_fn=lambda **kw: [0.0])
+
+
+def test_sequence_parallel_trainer_refuses_grpo_method():
+    from trlx_tpu.trainer.sequence_parallel_ppo_trainer import (
+        SequenceParallelPPOTrainer,
+    )
+
+    cfg = _critic_free_config(sequence=2)
+    with pytest.raises(NotImplementedError, match="GRPO/RLOO"):
+        SequenceParallelPPOTrainer(cfg, reward_fn=lambda **kw: [0.0])

@@ -181,3 +181,26 @@ def test_hot_param_swap_mid_flight(trainer):
         assert r2.token_ids == direct_generate(trainer, [7, 8, 9], 6)
     finally:
         sched.stop()
+
+
+def test_submit_n_without_paging_degrades_to_independent_requests(trainer):
+    """GRPO's G-per-prompt fan-out must not require the paged pool: with
+    kv_paging off, submit_n(p, 3) admits three independent fixed-slot
+    requests (no shared-prefix machinery to lean on) and every one
+    completes with the fresh-batch greedy output — graceful degradation,
+    not an error."""
+    engine = make_engine(trainer, num_slots=2, max_new=6, eos=EOS_FREE)
+    assert not engine.kv_paging
+    p = np.random.RandomState(31).randint(0, 255, size=19).tolist()
+    sched = Scheduler(engine, max_wait_s=0.0).start()
+    try:
+        reqs = sched.submit_n(p, 3, max_new_tokens=6)
+        assert len(reqs) == 3
+        for r in reqs:
+            assert r.wait(300)
+    finally:
+        sched.stop()
+    want = direct_generate(trainer, p, 6)
+    for r in reqs:
+        assert r.finish_reason == "length"
+        assert r.token_ids == want

@@ -255,6 +255,36 @@ def test_submit_n_fanout_shares_prompt_blocks(trainer):
     assert stats["kv_blocks_used"] == 0
 
 
+def test_submit_n_one_is_byte_equivalent_to_submit(trainer):
+    """submit_n(p, 1) must be indistinguishable from submit(p): same
+    single-request admission, byte-identical greedy output, and no
+    prefix-cache traffic difference between the two paths."""
+    engine = make_engine(trainer, num_slots=2, max_new=6,
+                         kv_paging=True, kv_block_size=16, prefix_cache=True)
+    p = np.random.RandomState(21).randint(0, 255, size=23).tolist()
+    sched = Scheduler(engine, max_wait_s=0.0).start()
+    try:
+        reqs = sched.submit_n(p, 1, max_new_tokens=6)
+        assert len(reqs) == 1
+        assert reqs[0].wait(300)
+        single = sched.submit(p, max_new_tokens=6)
+        assert single.wait(300)
+    finally:
+        sched.stop()
+    assert reqs[0].token_ids == single.token_ids
+    assert reqs[0].token_ids == direct_generate(trainer, p, 6)
+    assert reqs[0].finish_reason == single.finish_reason == "length"
+    assert reqs[0].max_new_tokens == single.max_new_tokens
+
+
+def test_submit_n_rejects_bad_n(trainer):
+    engine = make_engine(trainer, num_slots=2, max_new=4,
+                         kv_paging=True, kv_block_size=16)
+    sched = Scheduler(engine, max_wait_s=0.0)
+    with pytest.raises(ValueError):
+        sched.submit_n([1, 2, 3], 0, max_new_tokens=4)
+
+
 def test_int8_kv_within_tolerance(trainer):
     """int8 KV (per-token-per-head symmetric scales) must complete every
     request with a valid finish and track the f32 greedy path closely —

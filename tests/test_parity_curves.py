@@ -71,6 +71,27 @@ def test_task_learnable_signal(artifact):
         assert best >= 0.5, f"{method}: neither side learned (best {best})"
 
 
+def test_grpo_present_with_full_curves(artifact):
+    """The critic-free row exists: GRPO trained on the same task/budget,
+    compared against OUR PPO curve (there is no reference GRPO trainer)."""
+    entry = artifact["methods"]["grpo"]
+    assert "GRPOTrainer" in entry["ours"]["trainer"]
+    assert entry["ours"]["n_points"] >= 6
+    assert len(entry["ours"]["eval_curve"]) == entry["ours"]["n_points"]
+    assert entry["reference"]["n_points"] >= MIN_POINTS["ppo"]
+
+
+def test_grpo_within_90pct_of_ppo(artifact):
+    """Acceptance: dropping the value head keeps >= 90% of PPO's
+    last-quarter mean optimality on the same task and budget."""
+    entry = artifact["methods"]["grpo"]
+    ratio = entry["ours"]["mean_last_quarter"] / entry["reference"]["mean_last_quarter"]
+    assert ratio >= 0.9, (
+        f"GRPO reaches only {ratio:.1%} of the PPO baseline's last-quarter "
+        "mean optimality (acceptance floor: 90%)"
+    )
+
+
 def test_ours_learns_from_warm_start(artifact):
     """Our PPO must IMPROVE over training, not just coast on the warm
     checkpoint: mean of the last quarter above the first eval point."""

@@ -65,6 +65,10 @@ class PPORLElement:
     # [query_size + response_size(+1), d_model]; only populated when
     # method.cache_trunk_activations is on (None otherwise)
     h_split: Optional[np.ndarray] = None
+    # GRPO/RLOO: id of the G-completion prompt group this rollout belongs
+    # to — rides the store so group-relative normalization happens per
+    # prompt group, not per chunk (None for PPO)
+    group_id: Optional[int] = None
 
 
 @flax.struct.dataclass
@@ -82,6 +86,8 @@ class PPORLBatch:
     # in method.trunk_cache_dtype; None (no pytree leaf) when the trunk
     # cache is off, so every existing 5-field constructor/scan still works
     h_split: Any = None
+    # optional int32 [b] prompt-group ids (GRPO/RLOO); None for PPO
+    group_ids: Any = None
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,8 @@ from trlx_tpu.data.configs import (
     TrainConfig,
     TRLConfig,
 )
+from trlx_tpu.trainer.bon_trainer import BONConfig
+from trlx_tpu.trainer.grpo_trainer import GRPOConfig
 from trlx_tpu.trainer.ilql_trainer import ILQLConfig
 from trlx_tpu.trainer.ppo_trainer import PPOConfig
 from trlx_tpu.trainer.rft_trainer import RFTConfig
@@ -150,3 +152,50 @@ def default_rft_config():
             n_generations_per_prompt=32,
         ),
     )
+
+
+def default_grpo_config():
+    """Critic-free GRPO defaults: the PPO stack minus the value function,
+    plus the group knobs (group_size completions per prompt, in-loss KL to
+    the frozen reference). advantage_mode="rloo" switches the estimator to
+    the leave-one-out baseline."""
+    cfg = default_ppo_config().to_dict()
+    cfg["train"]["trainer"] = "GRPOTrainer"
+    # a full method swap, not a field merge: the value-function fields
+    # (gamma/lam/vf_coef/...) must not survive into the critic-free config
+    cfg["method"] = GRPOConfig(
+        name="GRPOConfig",
+            num_rollouts=128,
+            chunk_size=128,
+            ppo_epochs=4,
+            group_size=8,
+            advantage_mode="grpo",
+            grpo_kl_coef=0.02,
+            init_kl_coef=0.0,
+            target=None,
+            horizon=10000,
+            cliprange=0.2,
+            scale_reward=None,
+            ref_mean=None,
+            ref_std=None,
+            cliprange_reward=10,
+        gen_kwargs=dict(
+            max_new_tokens=40,
+            top_k=0,
+            top_p=1.0,
+            do_sample=True,
+        ),
+    ).to_dict()
+    return TRLConfig.from_dict(cfg)
+
+
+def default_bon_config():
+    """Best-of-n rejection-sampling distillation defaults."""
+    cfg = default_sft_config().to_dict()
+    cfg["train"]["trainer"] = "BestOfNTrainer"
+    cfg["method"] = BONConfig(
+        name="BONConfig",
+        gen_kwargs=dict(max_new_tokens=40, top_k=0, top_p=1.0, do_sample=True),
+        best_of_n=8,
+    ).to_dict()
+    return TRLConfig.from_dict(cfg)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from trlx_tpu.models.heads import ILQLHeads, MLPHead, sync_target_q_heads  # noqa: F401
 from trlx_tpu.models.policy import (  # noqa: F401
+    CausalLMPolicy,
     CausalLMWithILQLHeads,
     CausalLMWithValueHead,
     apply_trainable_mask,
@@ -124,13 +125,17 @@ def build_model(
     two_qs: bool = True,
     seq_len: int = 32,
     num_value_layers: int = 0,
+    value_head: bool = True,
 ) -> Tuple[Any, Any, Dict]:
     """Returns (flax module, model config, initialized params).
 
     `num_value_layers > 0` builds the deeper value branch (reference
     num_value_layers_unfrozen / make_value_branch, modeling_ppo.py:255-263):
     a trainable clone of the top-k blocks + final norm feeding the scalar
-    head, initialized from the (loaded) trunk weights."""
+    head, initialized from the (loaded) trunk weights.
+
+    `value_head=False` builds the critic-free CausalLMPolicy (GRPO/RLOO):
+    no value parameters exist anywhere in the returned tree."""
     cfg = resolve_transformer_config(model_config, vocab_size)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
@@ -141,6 +146,21 @@ def build_model(
             "num_value_layers_unfrozen with prompt/prefix tuning is not "
             "supported (the reference likewise leaves peft off the value branch)"
         )
+    if not value_head:
+        if is_seq2seq_config(cfg):
+            raise NotImplementedError(
+                "critic-free (value_head=False) models are causal-only"
+            )
+        if with_ilql_heads:
+            raise ValueError(
+                "value_head=False conflicts with with_ilql_heads (ILQL needs "
+                "its heads)"
+            )
+        if num_value_layers > 0:
+            raise ValueError(
+                "value_head=False conflicts with num_value_layers > 0: a "
+                "critic-free policy has no value branch to deepen"
+            )
     if is_seq2seq_config(cfg):
         if num_value_layers > 0:
             raise NotImplementedError(
@@ -160,6 +180,8 @@ def build_model(
             if num_value_layers > 0:
                 raise NotImplementedError("the value branch is a PPO-value-head feature")
             model = CausalLMWithILQLHeads(cfg, two_qs=two_qs)
+        elif not value_head:
+            model = CausalLMPolicy(cfg)
         else:
             model = CausalLMWithValueHead(cfg, num_value_layers=num_value_layers)
         tokens = jnp.zeros((1, min(seq_len, cfg.max_seq_len)), dtype=jnp.int32)

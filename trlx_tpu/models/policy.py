@@ -243,6 +243,66 @@ class CausalLMWithValueHead(nn.Module):
         return logits, values, new_layers
 
 
+class CausalLMPolicy(CausalLMWithValueHead):
+    """Critic-free policy: the LM alone, with NO value head anywhere in the
+    param tree (GRPO/RLOO delete the critic, so the tree must too — a
+    zero-init v_head would still allocate and train parameters, and the
+    tests assert its absence). Subclasses CausalLMWithValueHead so every
+    pure-`self.lm` delegate (reference forwards, cached decode, row
+    decode/prefill, spec draft) and `forward_policy_and_ref` work
+    unchanged; value-bearing surfaces return None in the values slot or
+    raise when a per-step value is explicitly requested."""
+
+    def setup(self):
+        if self.num_value_layers > 0:
+            raise ValueError(
+                "CausalLMPolicy is critic-free; num_value_layers must be 0"
+            )
+        self.lm = TransformerLM(self.cfg, name="lm")
+
+    def __call__(self, tokens, attn_mask, positions=None, split: int = 0):
+        logits, h_split, _ = self.lm(tokens, attn_mask, positions, split)
+        return logits, None, h_split
+
+    def forward_window(self, tokens, attn_mask, positions=None,
+                       start: int = 0, length: int = 1):
+        logits, _ = self.lm.forward_window(tokens, attn_mask, positions, start, length)
+        return logits, None
+
+    def forward_from_cache(self, h_split, attn_mask, positions=None,
+                           start_layer: int = 0):
+        logits, _, _ = self.lm.forward_from_captures(
+            h_split, attn_mask, positions, start_layer
+        )
+        return logits, None
+
+    def forward_from_cache_window(self, h_split, attn_mask, positions=None,
+                                  start_layer: int = 0, start: int = 0,
+                                  length: int = 1):
+        logits, _ = self.lm.forward_from_window(
+            h_split, attn_mask, positions, start_layer, start, length
+        )
+        return logits, None
+
+    def decode_step(self, tokens, cache, token_mask, is_prefill: bool = False,
+                    with_value: bool = False, capture_split=None):
+        if with_value:
+            raise NotImplementedError(
+                "CausalLMPolicy has no value head; decode with with_value=False"
+            )
+        return super().decode_step(tokens, cache, token_mask, is_prefill,
+                                   False, capture_split)
+
+    def spec_verify_rows(self, h, cache, row_start, positions, split: int,
+                         with_value: bool = False, token_mask=None):
+        if with_value:
+            raise NotImplementedError(
+                "CausalLMPolicy has no value head; verify with with_value=False"
+            )
+        return super().spec_verify_rows(h, cache, row_start, positions, split,
+                                        False, token_mask)
+
+
 class CausalLMWithILQLHeads(nn.Module):
     cfg: TransformerConfig
     two_qs: bool = True

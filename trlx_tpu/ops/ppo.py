@@ -120,3 +120,82 @@ def ppo_loss(
         padding_percentage=1.0 - n / mask.size,
     )
     return loss, stats
+
+
+def group_relative_advantages(
+    rewards: jnp.ndarray,  # [n_groups, group_size]
+    mode: str = "grpo",
+    eps: float = 1e-4,
+) -> jnp.ndarray:
+    """Critic-free advantage estimators over G completions per prompt.
+
+    mode="grpo" (Shao et al. 2024): standardize within the group,
+        A_i = (r_i - mean(r)) / (std(r) + eps).
+    The eps keeps a degenerate group (all rewards equal, std = 0) at
+    exactly zero advantage instead of 0/0 NaN.
+
+    mode="rloo" (Ahmadian et al. 2024): leave-one-out baseline,
+        A_i = r_i - mean(r_{j != i}) = (G * r_i - sum(r)) / (G - 1).
+    G = 1 has no leave-one-out set; the advantage degrades to the raw
+    reward (baseline 0) rather than dividing by zero.
+    """
+    rewards = rewards.astype(jnp.float32)
+    if mode == "grpo":
+        mean = rewards.mean(axis=-1, keepdims=True)
+        std = rewards.std(axis=-1, keepdims=True)
+        adv = (rewards - mean) / (std + eps)
+    elif mode == "rloo":
+        g = rewards.shape[-1]
+        if g <= 1:
+            adv = rewards
+        else:
+            total = rewards.sum(axis=-1, keepdims=True)
+            adv = (g * rewards - total) / (g - 1)
+    else:
+        raise ValueError(f"unknown advantage_mode '{mode}' (grpo | rloo)")
+    return jax.lax.stop_gradient(adv)
+
+
+def grpo_loss(
+    logprobs: jnp.ndarray,  # [b, response]
+    old_logprobs: jnp.ndarray,
+    ref_logprobs: jnp.ndarray,
+    advantages: jnp.ndarray,
+    mask: jnp.ndarray,
+    cliprange: float,
+    kl_coef: float,
+) -> Tuple[jnp.ndarray, Dict]:
+    """Critic-free clipped objective (GRPO, Shao et al. 2024 eq. 3): the
+    PPO clipped policy ratio against a group-relative advantage, plus an
+    explicit in-loss k3 KL penalty to the frozen reference — no value
+    loss, no GAE. RLOO reuses this loss with a different `advantages`
+    estimator (see group_relative_advantages)."""
+    mask = mask.astype(jnp.float32)
+    n = jnp.maximum(mask.sum(), 1.0)
+
+    log_ratio = (logprobs - old_logprobs) * mask
+    ratio = jnp.exp(log_ratio)
+    # k3 unbiased KL estimator, diagnostic only (http://joschu.net/blog/kl-approx.html)
+    approx_kl = jax.lax.stop_gradient(jnp.mean((ratio - 1) - log_ratio))
+
+    pg_loss1 = -advantages * ratio
+    pg_loss2 = -advantages * jnp.clip(ratio, 1.0 - cliprange, 1.0 + cliprange)
+    pg_loss = (jnp.maximum(pg_loss1, pg_loss2) * mask).sum() / n
+    pg_clipfrac = ((pg_loss2 > pg_loss1).astype(jnp.float32) * mask).sum() / n
+
+    # k3 KL to the REFERENCE policy, differentiable (the GRPO paper's
+    # unbiased estimator: exp(ref - pi) - (ref - pi) - 1 >= 0).
+    ref_log_ratio = (ref_logprobs - logprobs) * mask
+    kl_to_ref = ((jnp.exp(ref_log_ratio) - ref_log_ratio - 1.0) * mask).sum() / n
+
+    loss = pg_loss + kl_coef * kl_to_ref
+
+    stats = dict(
+        losses=dict(total_loss=loss, policy_loss=pg_loss, kl_loss=kl_to_ref),
+        policy=dict(approx_kl=approx_kl, clipfrac=pg_clipfrac),
+        advantages=get_tensor_stats(advantages, mask, n),
+        ref_kl=kl_to_ref,
+        ratio=(ratio * mask).sum() / n,
+        padding_percentage=1.0 - n / mask.size,
+    )
+    return loss, stats

@@ -105,6 +105,9 @@ class PPORolloutStorage(BaseRolloutStore):
                     else:
                         h_split[i, :qi] = e.h_split[:qi]
                     h_split[i, max_q:max_q + w] = e.h_split[qi:qi + w]
+            group_ids = None
+            if all(e.group_id is not None for e in elems):
+                group_ids = np.asarray([e.group_id for e in elems], dtype=np.int32)
             return PPORLBatch(
                 query_tensors=queries,
                 response_tensors=responses,
@@ -112,6 +115,7 @@ class PPORolloutStorage(BaseRolloutStore):
                 values=values,
                 rewards=rewards,
                 h_split=h_split,
+                group_ids=group_ids,
             )
 
         return DataLoader(

@@ -265,6 +265,16 @@ class TPUTrainer(BaseRLTrainer):
         """Full (merged) param tree."""
         return merge_params(self.train_params, self.frozen_params)
 
+    def serving_params(self) -> Dict:
+        """Param tree safe to hand to a long-lived consumer (an inference
+        engine held by an in-process replica): the jitted train step
+        DONATES train_params on every optimizer step, so anything that
+        keeps aliases to those buffers reads deleted arrays one update
+        later. Trainable leaves are copied; the frozen trunk is never
+        donated and stays shared live."""
+        train_copy = jax.tree_util.tree_map(jnp.copy, self.train_params)
+        return merge_params(train_copy, self.frozen_params)
+
     def next_rng(self) -> jax.Array:
         self.rng, key = jax.random.split(self.rng)
         # IDENTICAL across hosts, deliberately: every host runs the same
@@ -455,7 +465,7 @@ class TPUTrainer(BaseRLTrainer):
             gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
         )
         engine = InferenceEngine(
-            self.model, self.model_cfg, self.params, gen_cfg,
+            self.model, self.model_cfg, self.serving_params(), gen_cfg,
             num_slots=icfg.num_slots,
             max_prompt_len=icfg.max_prompt_len,
             max_prefill_batch=icfg.max_prefill_batch,

@@ -67,6 +67,19 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
 
     def __init__(self, config: TRLConfig, n_microbatches: Optional[int] = None, **kwargs):
         config = self._validate_pipeline_config(config)
+        if getattr(config.method, "advantage_mode", None) is not None:
+            # refuse critic-free method sections (GRPO/RLOO) up front with
+            # the one-time warning, not a shape error deep in pipe setup
+            if not getattr(self, "_warned_no_critic_free", False):
+                self._warned_no_critic_free = True
+                logger.warning(
+                    "critic-free methods (GRPO/RLOO) are not supported under "
+                    "pipeline parallelism; use the GSPMD GRPOTrainer"
+                )
+            raise NotImplementedError(
+                "GRPO/RLOO method configs are not supported under pipeline "
+                "parallelism; use the GSPMD GRPOTrainer"
+            )
         if getattr(config.method, "num_value_layers_unfrozen", 0):
             raise NotImplementedError(
                 "num_value_layers_unfrozen (the deeper value branch) is not "

@@ -151,6 +151,9 @@ class PPOTrainer(TPUTrainer):
         # supervises the replicas themselves (FleetSupervisor).
         self._rollout_router = None
         self._rollout_supervisor = None
+        # optimizer step the in-process replicas' engines last received
+        # params for (see _push_params_to_thread_replicas)
+        self._fleet_params_step = 0
 
     def _build_ref_params(self):
         """Extract + place the frozen reference subtree (overridden by the
@@ -525,6 +528,28 @@ class PPOTrainer(TPUTrainer):
         elif router is not None:
             router.close()
 
+    def _push_params_to_thread_replicas(self) -> None:
+        """Refresh in-process (ThreadReplica) seats with the live policy.
+        Out-of-process replicas pick up new weights through the
+        supervisor's checkpoint rolling sync; thread replicas share our
+        process, so their engines hold direct references to trainer
+        buffers — which the jitted train step donates every optimizer
+        step. Push a donation-safe snapshot (one copy, shared by every
+        seat) whenever the trainer has stepped since the last push, so a
+        rollout cycle after an update never serves from deleted arrays."""
+        sup = self._rollout_supervisor
+        if sup is None or self.iter_count == self._fleet_params_step:
+            return
+        params = None
+        for seat in sup.seats:
+            engine = getattr(getattr(seat.handle, "server", None), "engine", None)
+            if engine is None:
+                continue
+            if params is None:
+                params = self.serving_params()
+            engine.set_params(params)
+        self._fleet_params_step = self.iter_count
+
     def _fleet_generate(self, batch, gen_kwargs, trainer_step: int = 0):
         """Generate one chunk on the rollout fleet; same out-dict shape as
         the local sampler (`samples` = prompt block + response columns,
@@ -546,6 +571,7 @@ class PPOTrainer(TPUTrainer):
         ]
         router = self._get_rollout_router()
         if self._rollout_supervisor is not None:
+            self._push_params_to_thread_replicas()
             # supervised replicas only advance when the supervisor rolls
             # a checkpoint through the fleet, so the staleness bound
             # anchors to the last synced step — anchoring to the raw

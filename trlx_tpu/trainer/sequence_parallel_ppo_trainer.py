@@ -58,6 +58,19 @@ class SequenceParallelPPOTrainer(PPOTrainer):
         config = validate_sequence_parallel_config(config, type(self).__name__)
         if config.model.model_arch_type != "causal":
             raise NotImplementedError("sequence-parallel PPO covers causal models")
+        if getattr(config.method, "advantage_mode", None) is not None:
+            # refuse critic-free method sections (GRPO/RLOO) up front with
+            # the one-time warning, not a shape error deep in shard_map setup
+            if not getattr(self, "_warned_no_critic_free", False):
+                self._warned_no_critic_free = True
+                logger.warning(
+                    "critic-free methods (GRPO/RLOO) are not supported under "
+                    "sequence parallelism; use the GSPMD GRPOTrainer"
+                )
+            raise NotImplementedError(
+                "GRPO/RLOO method configs are not supported under sequence "
+                "parallelism; use the GSPMD GRPOTrainer"
+            )
         if getattr(config.method, "num_value_layers_unfrozen", 0):
             raise NotImplementedError(
                 "the deeper value branch under sequence parallelism is not "

@@ -20,6 +20,8 @@ for _mod in (
     "trlx_tpu.trainer.sft_trainer",
     "trlx_tpu.trainer.ilql_trainer",
     "trlx_tpu.trainer.rft_trainer",
+    "trlx_tpu.trainer.grpo_trainer",
+    "trlx_tpu.trainer.bon_trainer",
     "trlx_tpu.trainer.pipelined_sft_trainer",
     "trlx_tpu.trainer.pipelined_ilql_trainer",
     "trlx_tpu.trainer.pipelined_ppo_trainer",

@@ -28,14 +28,30 @@ docs/serving.md):
                                       "spares": 1, "watch_dir": "ckpts",
                                       "metrics_port": 8700}'
 
+    # multi-tenant LoRA serving: one trunk, many adapters hot-swapping
+    # from adapter_dir (subdirectory name = adapter id); requests pick
+    # their adapter with "adapter_id", tenants share every decode step
+    # and fair-share admission keeps a hot tenant from starving the rest
+    # (docs/serving.md). The checkpoint must be LoRA-enabled.
+    python examples/serve_policy.py '{"checkpoint": "ckpts/hf_model",
+                                      "adapter_dir": "adapters",
+                                      "inference.multi_tenant": true}'
+
     # then, from anywhere:
     curl -s localhost:8600/generate -d '{"prompt": "hello", "max_new_tokens": 32}'
+    curl -s localhost:8600/generate -d '{"prompt": "hello", "adapter_id": "tenant-a"}'
     curl -s localhost:8600/healthz
     curl -s localhost:8600/metrics
+    curl -s localhost:8600/admin/adapters
+
+    # or with the python client (adapter_id rides along per call):
+    #   from trlx_tpu.inference import remote_generate
+    #   gen = remote_generate("http://localhost:8600")
+    #   gen("hello", max_new_tokens=32, adapter_id="tenant-a")
 
 Any dotted TRLConfig key in the hparams JSON overrides the config — the
 `inference.*` section holds the serving knobs (slots, queue depth,
-deadlines, gen_kwargs; docs/configs.md).
+deadlines, gen_kwargs, multi-tenancy; docs/configs.md).
 """
 
 import json
@@ -62,6 +78,7 @@ def main(hparams=None):
     spares = int(hparams.pop("spares", 0))
     metrics_port = hparams.pop("metrics_port", None)
     supervisor_kwargs = dict(hparams.pop("supervisor_kwargs", None) or {})
+    adapter_dir = hparams.pop("adapter_dir", None)
 
     config = default_sft_config().evolve(
         model=dict(model_path=checkpoint),
@@ -70,7 +87,14 @@ def main(hparams=None):
                    checkpoint_dir=os.path.join("/tmp", "_serve_ckpt")),
         # under supervision the replicas must NOT self-watch the dir:
         # the supervisor owns reloads (rolling, one replica at a time)
-        inference=dict(port=port, watch_dir=None if supervised else watch_dir),
+        inference=dict(
+            port=port,
+            watch_dir=None if supervised else watch_dir,
+            # an adapter_dir implies multi-tenant serving (the hparams
+            # can still flip inference.multi_tenant explicitly)
+            **({"adapter_dir": adapter_dir, "multi_tenant": True}
+               if adapter_dir else {}),
+        ),
     )
     if hparams:
         config = TRLConfig.update(config, hparams)

@@ -146,6 +146,56 @@ def test_merge_and_unload():
     )
 
 
+def test_adapter_checkpoint_round_trip_is_bitwise(tmp_path):
+    """Serving-side adapter round trip: split_lora -> adapter checkpoint
+    on disk -> AdapterStore -> gathered `lora_rows` forward is BITWISE
+    the param-path forward, and the zero adapter (stack slot 0) is
+    bitwise the zero_lora base — the invariant multi-tenant serving
+    rests on (one heterogeneous batch == N single-adapter models)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from trlx_tpu import resilience
+    from trlx_tpu.inference.adapters import AdapterStore
+
+    cfg, model, params, tokens, mask = _build()
+    perturbed = _perturb_lora(params)
+    lora_flat, _ = split_lora(perturbed)
+    adapter_dir = tmp_path / "adapters"
+    d = str(adapter_dir / "t1")
+    ocp.PyTreeCheckpointer().save(
+        os.path.join(d, "state"),
+        {"train_params": {str(k): np.asarray(v) for k, v in lora_flat.items()}},
+        force=True,
+    )
+    resilience.write_manifest(d, step=1)
+
+    store = AdapterStore(params, adapter_dir=str(adapter_dir), max_resident=2)
+    slot = store.acquire("t1")
+    assert slot == 1
+    stack = store.stacked()
+
+    def gather(index):
+        idx = jnp.full((tokens.shape[0],), index, jnp.int32)
+        return jax.tree_util.tree_map(lambda s: s[idx], stack)
+
+    logits_rows, *_ = model.apply(
+        {"params": params, "lora_rows": gather(slot)}, tokens, mask
+    )
+    logits_param, *_ = model.apply({"params": perturbed}, tokens, mask)
+    np.testing.assert_array_equal(np.asarray(logits_rows), np.asarray(logits_param))
+
+    logits_zero, *_ = model.apply(
+        {"params": perturbed, "lora_rows": gather(0)}, tokens, mask
+    )
+    logits_base, *_ = model.apply({"params": zero_lora(perturbed)}, tokens, mask)
+    np.testing.assert_array_equal(np.asarray(logits_zero), np.asarray(logits_base))
+
+    store.release("t1")
+    assert store.refcount("t1") == 0
+
+
 def test_build_model_with_peft_config():
     mc = ModelConfig(model_path="random:gpt2-tiny", peft_config=PEFT_CONFIG,
                      model_extra_configs={"dtype": "float32"})

@@ -214,6 +214,30 @@ class InferenceConfig:
         requires kv_paging.
     :param prefix_cache_capacity: max idle cached blocks retained after
         release; 0 = bounded only by allocation pressure.
+    :param multi_tenant: serve many LoRA adapters over one shared trunk
+        (S-LoRA shape): per-request `adapter_id` picks the adapter,
+        requests from different tenants share every decode step (batched
+        heterogeneous-adapter gather), and prefix-cache keys are salted
+        per adapter so K/V never crosses tenants. Requires a
+        LoRA-enabled policy; off = single-policy serving, bit-identical
+        to previous behavior.
+    :param adapter_dir: directory of adapter checkpoints (subdirectory
+        name = adapter id, each a trainer `save` of adapters+heads);
+        adapters load on demand and hot-reload per adapter when their
+        checkpoint moves.
+    :param max_resident_adapters: device-resident adapter slots; idle
+        adapters evict LRU-first when slots run out.
+    :param adapter_hbm_budget_mb: cap resident-adapter HBM bytes; the
+        effective capacity is min(max_resident_adapters, budget //
+        bytes-per-adapter). 0 = no byte cap.
+    :param fair_share: weighted deficit round-robin admission across
+        tenants (multi-tenant only) — a saturating tenant cannot starve
+        the others; off = global FIFO.
+    :param tenant_weights: relative fair-share weights by adapter id
+        (missing tenants weigh 1.0; the base policy is tenant "base").
+    :param tenant_queue_depth: per-tenant queued-request cap, rejected
+        with HTTP 503 + Retry-After beyond it; 0 = only the global
+        max_queue_depth applies.
     """
 
     num_slots: int = 8
@@ -235,6 +259,13 @@ class InferenceConfig:
     kv_cache_dtype: str = "auto"
     prefix_cache: bool = False
     prefix_cache_capacity: int = 0
+    multi_tenant: bool = False
+    adapter_dir: Optional[str] = None
+    max_resident_adapters: int = 8
+    adapter_hbm_budget_mb: float = 0.0
+    fair_share: bool = True
+    tenant_weights: Dict[str, float] = field(default_factory=dict)
+    tenant_queue_depth: int = 0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -4,8 +4,14 @@
   KV-cache pool (jitted prefill / decode_step), optionally paged
   (`kv_paging`) with shared-prefix block reuse and int8 KV quantization;
 - `paging` — host-side `BlockPool`: free-list block allocation,
-  refcounted exact-match prefix store, LRU idle eviction;
-- `scheduler` — FIFO admission, max-wait batching, bounded queue with
+  refcounted exact-match prefix store (adapter-salted keys under
+  multi-tenancy), LRU idle eviction;
+- `adapters` — multi-tenant `AdapterStore`: directory-backed LRU store
+  of device-resident stacked LoRA factors (refcounted, HBM-budgeted),
+  enabling Punica-style batched heterogeneous-adapter decode over one
+  shared trunk;
+- `scheduler` — FIFO admission (weighted deficit round-robin fair-share
+  under multi-tenancy), max-wait batching, bounded queue with
   backpressure, per-request deadlines, drain for weight sync,
   reject-new/finish-inflight draining for graceful shutdown;
 - `server` — HTTP `POST /generate` + `/healthz` (liveness/readiness) +
@@ -20,6 +26,13 @@
   rolling weight sync that never drops serving capacity below N-1.
 """
 
+from trlx_tpu.inference.adapters import (
+    AdapterCapacityError,
+    AdapterError,
+    AdapterNotFoundError,
+    AdapterStore,
+    adapter_salt,
+)
 from trlx_tpu.inference.client import remote_generate
 from trlx_tpu.inference.engine import InferenceEngine
 from trlx_tpu.inference.fleet import FleetUnavailableError, Replica, ReplicaRouter
@@ -44,6 +57,10 @@ from trlx_tpu.inference.supervisor import (
 )
 
 __all__ = [
+    "AdapterCapacityError",
+    "AdapterError",
+    "AdapterNotFoundError",
+    "AdapterStore",
     "BlockPool",
     "CheckpointWatcher",
     "DrainingError",
@@ -61,6 +78,7 @@ __all__ = [
     "Scheduler",
     "SubprocessReplica",
     "ThreadReplica",
+    "adapter_salt",
     "load_checkpoint_params",
     "prefix_keys",
     "remote_generate",

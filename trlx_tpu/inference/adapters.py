@@ -1,0 +1,373 @@
+"""Hot-swappable multi-tenant LoRA adapter store (the S-LoRA shape).
+
+One frozen trunk sits in HBM once (int8 when the engine quantizes it);
+per-tenant LoRA deltas are tiny `[d, r]`/`[r, feats]` factor pairs that
+hot-swap under it. The store owns the device-resident factors as
+**stacked** arrays — one `[n_slots, ...]` array per LoRA leaf path — so
+the jitted decode step can gather each batch row's factors by adapter
+index (Punica-style batched heterogeneous decode: requests from
+different tenants share every decode step, see `lora_dense`'s
+`lora_rows` branch). Slot 0 is permanently the zero adapter: gathering
+it reproduces the base policy bitwise, so "no adapter" is not a special
+case anywhere in the engine.
+
+Lifecycle mirrors the paged prefix store (paging.py): adapters load on
+demand from `adapter_dir/<name>` trainer checkpoints (the adapters+heads
+orbax state `trainable_mask` produces), are refcounted while any
+request is in flight, and idle residents evict LRU-oldest when slots run
+out. Capacity is the tighter of `max_resident` and an HBM byte budget —
+the budget is the knob the A/B harness turns to show N adapters on one
+trunk beating N monolithic policies at equal HBM.
+
+Thread safety: one RLock. Callers are the scheduler driver thread
+(acquire/release around slot lifecycle) and HTTP admin threads
+(list/load/evict/reload).
+"""
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trlx_tpu import resilience
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+#: names that all mean "the base policy" (stack slot 0, zero factors)
+BASE_NAMES = (None, "", "base")
+
+
+class AdapterError(RuntimeError):
+    """Base class for adapter-store failures."""
+
+
+class AdapterNotFoundError(AdapterError):
+    """No manifest-complete checkpoint for the requested adapter."""
+
+
+class AdapterCapacityError(AdapterError):
+    """Every resident slot is pinned by in-flight requests — the caller
+    should retry once some finish (the scheduler requeues on this, the
+    server answers 503 + Retry-After)."""
+
+
+def adapter_salt(name: Optional[str]) -> bytes:
+    """Prefix-cache salt for one adapter. The base policy keeps the
+    unsalted key space (existing caches stay valid when multi-tenancy
+    turns on); adapter salts are NUL-terminated so no salt is ever a
+    byte prefix of another and per-adapter flushes match exactly."""
+    if name in BASE_NAMES:
+        return b""
+    return b"adapter\x00" + str(name).encode("utf-8") + b"\x00"
+
+
+def load_adapter_leaves(directory: str) -> Dict[Tuple[str, ...], np.ndarray]:
+    """Restore the LoRA leaves from a trainer checkpoint directory
+    (`TPUTrainer.save` layout — orbax `state/` with flat tuple-keyed
+    partitions). Only `train_params` is read (under peft that partition
+    IS adapters+heads) and only `*_lora_*` leaves are kept, so value
+    heads and optimizer state never reach the serving stack."""
+    import ast
+
+    import orbax.checkpoint as ocp
+
+    raw = ocp.PyTreeCheckpointer().restore(os.path.join(directory, "state"))
+    out: Dict[Tuple[str, ...], np.ndarray] = {}
+    for k, v in (raw.get("train_params") or {}).items():
+        key = ast.literal_eval(k) if isinstance(k, str) and k.startswith("(") else (k,)
+        key = tuple(key)
+        if any("_lora_" in str(p) for p in key):
+            out[key] = np.asarray(v)
+    if not out:
+        raise AdapterNotFoundError(
+            f"checkpoint at {directory} holds no LoRA leaves in train_params"
+        )
+    return out
+
+
+class AdapterStore:
+    """Directory-backed LRU store of device-resident stacked LoRA factors.
+
+    `params` is the serving param tree of the (LoRA-enabled) policy — it
+    supplies the leaf paths/shapes/dtypes the stack is built from; its
+    actual adapter values are never served (multi-tenant programs read
+    factors exclusively from the stack, and slot 0 is zeros)."""
+
+    def __init__(
+        self,
+        params: Dict,
+        adapter_dir: Optional[str] = None,
+        max_resident: int = 8,
+        hbm_budget_bytes: int = 0,
+        loader=load_adapter_leaves,
+    ):
+        from trlx_tpu.models.lora import split_lora
+
+        lora_flat, _ = split_lora(params)
+        if not lora_flat:
+            raise ValueError(
+                "AdapterStore needs a LoRA-enabled policy (cfg.lora_rank > 0); "
+                "the param tree holds no *_lora_* leaves"
+            )
+        self.adapter_dir = adapter_dir
+        self.loader = loader
+        self._paths = sorted(lora_flat)
+        self.bytes_per_adapter = int(
+            sum(int(np.prod(lora_flat[p].shape)) * jnp.dtype(lora_flat[p].dtype).itemsize
+                for p in self._paths)
+        )
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        capacity = int(max_resident)
+        if self.hbm_budget_bytes:
+            capacity = min(capacity, self.hbm_budget_bytes // self.bytes_per_adapter)
+        if capacity < 1:
+            raise ValueError(
+                f"adapter HBM budget {hbm_budget_bytes}B fits no adapter "
+                f"({self.bytes_per_adapter}B each)"
+            )
+        self.capacity = capacity
+        # slot 0 = base (zeros, never evicted); slots 1..capacity = tenants
+        self._stack: Dict[Tuple[str, ...], jnp.ndarray] = {
+            p: jnp.zeros((capacity + 1,) + tuple(lora_flat[p].shape),
+                         jnp.dtype(lora_flat[p].dtype))
+            for p in self._paths
+        }
+        self._free_slots: List[int] = list(range(capacity, 0, -1))
+        self._slot_of: Dict[str, int] = {}
+        self._name_of: Dict[int, str] = {}
+        self._refs: Dict[str, int] = {}
+        self._lru: "OrderedDict[str, None]" = OrderedDict()  # idle residents, oldest first
+        self._versions: Dict[str, tuple] = {}  # name -> manifest (step, wall_time)
+        self.loads = 0
+        self.evictions = 0
+        self.reloads = 0
+        self._lock = threading.RLock()
+
+    # -- discovery ------------------------------------------------------
+
+    def adapter_path(self, name: str) -> Optional[str]:
+        if self.adapter_dir is None:
+            return None
+        return os.path.join(self.adapter_dir, str(name))
+
+    def scan(self) -> List[str]:
+        """Manifest-complete adapter checkpoints under `adapter_dir`
+        (subdirectory name = adapter id). Half-written saves have no
+        manifest yet and stay invisible, exactly like CheckpointWatcher."""
+        if not self.adapter_dir or not os.path.isdir(self.adapter_dir):
+            return []
+        names = []
+        for entry in sorted(os.listdir(self.adapter_dir)):
+            path = os.path.join(self.adapter_dir, entry)
+            if os.path.isdir(path) and resilience.read_manifest(path) is not None:
+                names.append(entry)
+        return names
+
+    def known(self, name: Optional[str]) -> bool:
+        """Resident now, or loadable from disk."""
+        if name in BASE_NAMES:
+            return True
+        with self._lock:
+            if name in self._slot_of:
+                return True
+        path = self.adapter_path(name)
+        return path is not None and resilience.read_manifest(path) is not None
+
+    def resident(self) -> List[str]:
+        with self._lock:
+            return sorted(self._slot_of)
+
+    # -- slot lifecycle -------------------------------------------------
+
+    def acquire(self, name: Optional[str]) -> int:
+        """Pin `name` resident and return its stack slot. Loads from disk
+        on miss, evicting the LRU-oldest idle resident under slot
+        pressure; raises AdapterCapacityError when every slot is pinned."""
+        if name in BASE_NAMES:
+            return 0
+        name = str(name)
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                slot = self._load_locked(name)
+            self._refs[name] = self._refs.get(name, 0) + 1
+            self._lru.pop(name, None)
+            return slot
+
+    def release(self, name: Optional[str]) -> None:
+        """Drop one pin. Idle residents stay in the stack (still serving
+        zero-load acquires) until slot pressure evicts them LRU-first."""
+        if name in BASE_NAMES:
+            return
+        name = str(name)
+        with self._lock:
+            left = self._refs.get(name, 0) - 1
+            if left > 0:
+                self._refs[name] = left
+                return
+            self._refs.pop(name, None)
+            if name in self._slot_of:
+                self._lru[name] = None
+                self._lru.move_to_end(name)
+
+    def load(self, name: str) -> int:
+        """Admin preload: make `name` resident without pinning it."""
+        name = str(name)
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                slot = self._load_locked(name)
+                if self._refs.get(name, 0) == 0:
+                    self._lru[name] = None
+            return slot
+
+    def evict(self, name: str) -> None:
+        """Admin eviction. Refuses while requests are in flight."""
+        name = str(name)
+        with self._lock:
+            if name not in self._slot_of:
+                raise AdapterNotFoundError(f"adapter '{name}' is not resident")
+            if self._refs.get(name, 0) > 0:
+                raise AdapterError(f"adapter '{name}' has in-flight requests")
+            self._evict_locked(name)
+
+    def reload(self, name: str) -> bool:
+        """Re-read `name`'s checkpoint into its existing slot (per-adapter
+        hot-reload; the caller drains that adapter's slots first — the
+        store refuses while pinned). Returns False when the on-disk
+        version already matches the resident one."""
+        name = str(name)
+        with self._lock:
+            slot = self._slot_of.get(name)
+            if slot is None:
+                raise AdapterNotFoundError(f"adapter '{name}' is not resident")
+            if self._refs.get(name, 0) > 0:
+                raise AdapterError(f"adapter '{name}' has in-flight requests")
+            version = self._disk_version(name)
+            if version is not None and version == self._versions.get(name):
+                return False
+            self._write_slot(name, slot)
+            self.reloads += 1
+            return True
+
+    def changed(self) -> List[str]:
+        """Resident adapters whose on-disk checkpoint is newer than the
+        loaded one (the per-adapter analogue of CheckpointWatcher's poll)."""
+        with self._lock:
+            stale = []
+            for name in self._slot_of:
+                version = self._disk_version(name)
+                if version is not None and version != self._versions.get(name):
+                    stale.append(name)
+            return stale
+
+    # -- engine-facing views --------------------------------------------
+
+    def stacked(self) -> Dict:
+        """The current stacked factor tree, nested to mirror the param
+        tree (the `lora_rows` collection shape, pre-gather). Content
+        swaps replace leaves at fixed [capacity+1, ...] shapes, so jitted
+        programs taking this as an argument never recompile."""
+        from flax import traverse_util
+
+        with self._lock:
+            return traverse_util.unflatten_dict(dict(self._stack))
+
+    def salt(self, name: Optional[str]) -> bytes:
+        return adapter_salt(name)
+
+    def refcount(self, name: str) -> int:
+        with self._lock:
+            return self._refs.get(str(name), 0)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "resident": sorted(self._slot_of),
+                "capacity": self.capacity,
+                "bytes_per_adapter": self.bytes_per_adapter,
+                "resident_bytes": self.bytes_per_adapter * len(self._slot_of),
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "reloads": self.reloads,
+            }
+
+    # -- internals ------------------------------------------------------
+
+    def _disk_version(self, name: str) -> Optional[tuple]:
+        path = self.adapter_path(name)
+        if path is None:
+            return None
+        manifest = resilience.read_manifest(path)
+        if manifest is None:
+            return None
+        return (manifest.get("step"), manifest.get("wall_time"))
+
+    def _load_locked(self, name: str) -> int:
+        if self._free_slots:
+            slot = self._free_slots.pop()
+        elif self._lru:
+            victim, _ = self._lru.popitem(last=False)
+            slot = self._evict_locked(victim)
+            self._free_slots.remove(slot)
+        else:
+            raise AdapterCapacityError(
+                f"all {self.capacity} adapter slots are pinned by in-flight "
+                f"requests; cannot load '{name}'"
+            )
+        try:
+            self._write_slot(name, slot)
+        except Exception:
+            self._free_slots.append(slot)
+            raise
+        self.loads += 1
+        return slot
+
+    def _evict_locked(self, name: str) -> int:
+        slot = self._slot_of.pop(name)
+        self._name_of.pop(slot, None)
+        self._versions.pop(name, None)
+        self._lru.pop(name, None)
+        self._free_slots.append(slot)
+        self.evictions += 1
+        logger.info(f"adapter store: evicted '{name}' from slot {slot}")
+        return slot
+
+    def _write_slot(self, name: str, slot: int) -> None:
+        path = self.adapter_path(name)
+        if path is None or resilience.read_manifest(path) is None:
+            raise AdapterNotFoundError(
+                f"no manifest-complete checkpoint for adapter '{name}'"
+                + (f" at {path}" if path else " (no adapter_dir configured)")
+            )
+        leaves = self.loader(path)
+        if sorted(leaves) != self._paths:
+            missing = [p for p in self._paths if p not in leaves]
+            extra = [p for p in leaves if p not in self._stack]
+            raise AdapterError(
+                f"adapter '{name}' leaf paths do not match the serving policy "
+                f"(missing {missing[:3]}..., unexpected {extra[:3]}...)"
+                if (missing or extra) else
+                f"adapter '{name}' leaf paths do not match the serving policy"
+            )
+        for p in self._paths:
+            leaf = leaves[p]
+            want = self._stack[p].shape[1:]
+            if tuple(leaf.shape) != want:
+                raise AdapterError(
+                    f"adapter '{name}' leaf {'/'.join(p)} has shape "
+                    f"{tuple(leaf.shape)}, policy expects {want}"
+                )
+        for p in self._paths:
+            self._stack[p] = self._stack[p].at[slot].set(
+                jnp.asarray(leaves[p], self._stack[p].dtype)
+            )
+        self._slot_of[name] = slot
+        self._name_of[slot] = name
+        self._versions[name] = self._disk_version(name)
+        logger.info(f"adapter store: loaded '{name}' into slot {slot}")

@@ -31,7 +31,10 @@ def remote_generate(
     The returned callable accepts one prompt (str or token-id list) or a
     list of prompts; lists fan out over `concurrency` threads — the
     server's continuous batching turns the concurrent singles into one
-    shared decode batch. Per-call kwargs: `max_new_tokens`, `deadline_s`.
+    shared decode batch. Per-call kwargs: `max_new_tokens`, `deadline_s`,
+    and — against a multi-tenant server — `adapter_id` (which LoRA
+    adapter decodes the request; omitted = the base policy; requests for
+    different adapters still share every decode step server-side).
     Returns the response dict (or list of dicts): `text` (when the
     server has a tokenizer), `token_ids`, `finish_reason`, `latency_s`.
     """

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trlx_tpu.inference.adapters import adapter_salt
 from trlx_tpu.inference.paging import BlockPool, KVPoolExhaustedError, prefix_keys
 from trlx_tpu.models.transformer import init_kv_cache, init_paged_kv_arena
 from trlx_tpu.ops.quant import dequantize_tree
@@ -64,6 +65,15 @@ def _pow2_bucket(n: int, cap: int) -> int:
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+def _gather_rows(stack, idx):
+    """Per-row adapter factors from the store's stacked tree: every leaf
+    [n_slots, ...] -> [rows, ...] gathered by each row's adapter index.
+    Shapes the `lora_rows` collection `lora_dense` reads (one factor pair
+    per batch row), traced inside the prefill/decode programs so a
+    heterogeneous batch is one program."""
+    return jax.tree_util.tree_map(lambda s: s[idx], stack)
 
 
 _KV_DTYPES = {
@@ -108,11 +118,26 @@ class InferenceEngine:
         kv_cache_dtype: str = "auto",
         prefix_cache: bool = False,
         prefix_cache_capacity: int = 0,
+        multi_tenant: bool = False,
+        adapter_store=None,
     ):
         if getattr(model_cfg, "is_seq2seq", False):
             raise NotImplementedError(
                 "the continuous-batching engine serves causal LMs only"
             )
+        if multi_tenant:
+            if adapter_store is None:
+                raise ValueError("multi_tenant serving needs an AdapterStore")
+            if spec_k > 0:
+                raise NotImplementedError(
+                    "speculative decode under multi-tenant adapters is "
+                    "unsupported (the draft head is per-policy)"
+                )
+            if getattr(model_cfg, "lora_rank", 0) <= 0:
+                raise ValueError(
+                    "multi_tenant serving needs a LoRA-enabled policy "
+                    "(cfg.lora_rank > 0)"
+                )
         if spec_k > 0:
             if spec_split <= 0:
                 raise ValueError(
@@ -148,6 +173,10 @@ class InferenceEngine:
         self.kv_paging = bool(kv_paging)
         self.kv_block_size = int(kv_block_size)
         self.prefix_cache = bool(prefix_cache) and self.kv_paging
+        self.multi_tenant = bool(multi_tenant)
+        self.adapter_store = adapter_store if self.multi_tenant else None
+        # slot -> adapter name for requests in flight (store ref held)
+        self._slot_adapter: Dict[int, Optional[str]] = {}
         if kv_cache_dtype not in _KV_DTYPES:
             raise ValueError(
                 f"kv_cache_dtype {kv_cache_dtype!r} not in {sorted(_KV_DTYPES)}"
@@ -241,6 +270,12 @@ class InferenceEngine:
         }
         if self.kv_paging:
             self._pool["table"] = jnp.zeros((P, self._n_tbl), jnp.int32)
+        if self.multi_tenant:
+            # per-slot adapter stack index (0 = base). Gathered by the
+            # decode program each step; stale indices on inactive rows
+            # stay in-bounds (the store never shrinks its stack), so they
+            # only feed rows whose outputs are already ignored.
+            self._pool["adapter"] = jnp.zeros((P,), jnp.int32)
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._insert_fns: Dict[int, Callable] = {}
         self._paged_insert_fns: Dict[Tuple[int, int], Callable] = {}
@@ -325,14 +360,19 @@ class InferenceEngine:
         key = (pb, plen)
         if key not in self._prefill_fns:
             model, cfg, S = self.model, self.model_cfg, self._cache_len
+            mt = self.multi_tenant
 
-            def prefill(params, ids, mask):
+            def prefill(params, ids, mask, stack=None, aidx=None):
                 # no-op for dense trees; reconstructs the int8 frozen-trunk
                 # view in-graph (ops/quant.py)
                 params = dequantize_tree(params)
+                variables = {"params": params}
+                if mt:
+                    # the prompt's K/V must carry each row's own adapter
+                    variables["lora_rows"] = _gather_rows(stack, aidx)
                 cache = init_kv_cache(cfg, ids.shape[0], S)
                 out = model.apply(
-                    {"params": params}, ids, cache, mask, True,
+                    variables, ids, cache, mask, True,
                     method=type(model).decode_step,
                 )
                 logits, new_cache = out[0], out[-1]
@@ -344,8 +384,9 @@ class InferenceEngine:
     def _get_insert(self, pb: int) -> Callable:
         if pb not in self._insert_fns:
             sample_fused = self._sample_fused
+            mt = self.multi_tenant
 
-            def insert(pool, cache, last_logits, slot_ids, max_new):
+            def insert(pool, cache, last_logits, slot_ids, max_new, aidx=None):
                 # slot_ids >= num_slots mark padding rows: out-of-bounds
                 # scatter updates are dropped, so they never land
                 layers = [
@@ -364,7 +405,7 @@ class InferenceEngine:
                 # scatter drops
                 rng, key = jax.random.split(pool["rng"])
                 token, lp = sample_fused(last_logits, key, 0)
-                return {
+                new_pool = {
                     **pool,
                     "layers": layers,
                     "mask": pool["mask"].at[slot_ids].set(cache["mask"]),
@@ -377,6 +418,9 @@ class InferenceEngine:
                     "next_logprob": pool["next_logprob"].at[slot_ids].set(lp),
                     "rng": rng,
                 }
+                if mt:
+                    new_pool["adapter"] = pool["adapter"].at[slot_ids].set(aidx)
+                return new_pool
 
             # donate the old pool (the scatter aliases it); the prefill
             # cache can't alias (different leading dim), so it isn't listed
@@ -394,9 +438,14 @@ class InferenceEngine:
         if key not in self._paged_insert_fns:
             model, S, P = self.model, self._cache_len, self.num_slots
             sample_fused = self._sample_fused
+            mt = self.multi_tenant
 
-            def insert(pool, params, ids, tmask, tables, slot_ids, max_new, shared_len):
+            def insert(pool, params, ids, tmask, tables, slot_ids, max_new,
+                       shared_len, stack=None, aidx=None):
                 params = dequantize_tree(params)
+                variables = {"params": params}
+                if mt:
+                    variables["lora_rows"] = _gather_rows(stack, aidx)
                 # temp per-request cache rows backed by the SHARED arena;
                 # a cached prefix is already resident in blocks
                 # tables[:, : shared_len // block], so only its mask bits
@@ -412,7 +461,7 @@ class InferenceEngine:
                     "row_index": shared_len,
                 }
                 logits, new_cache = model.apply(
-                    {"params": params}, ids, cache, tmask,
+                    variables, ids, cache, tmask,
                     method=type(model).prefill_rows,
                 )
                 # per-row LAST-valid-position logits (right padding)
@@ -429,7 +478,7 @@ class InferenceEngine:
                 # padding rows carry slot_id == num_slots and all-OOB
                 # tables: both their arena writes (inside prefill_rows)
                 # and these pool scatters are dropped
-                return {
+                new_pool = {
                     **pool,
                     "layers": arena,
                     "table": pool["table"].at[slot_ids].set(tables),
@@ -445,32 +494,86 @@ class InferenceEngine:
                     "next_logprob": pool["next_logprob"].at[slot_ids].set(lp),
                     "rng": rng,
                 }
+                if mt:
+                    new_pool["adapter"] = pool["adapter"].at[slot_ids].set(aidx)
+                return new_pool
 
             self._paged_insert_fns[key] = jax.jit(insert, donate_argnums=(0,))
         return self._paged_insert_fns[key]
 
+    @staticmethod
+    def _split_row(row) -> Tuple[np.ndarray, int, Optional[str]]:
+        """Normalize an insert row to (ids, max_new, adapter_name) —
+        callers without multi-tenancy keep passing 2-tuples."""
+        if len(row) == 3:
+            return row[0], row[1], row[2]
+        ids, max_new = row
+        return ids, max_new, None
+
     def insert_requests(
         self,
-        rows: Sequence[Tuple[np.ndarray, int]],  # (unpadded prompt ids, max_new)
+        rows: Sequence[Tuple],  # (unpadded prompt ids, max_new[, adapter_id])
         slot_ids: Sequence[int],
     ) -> None:
         """Prefill `rows` (length-bucketed, left-padded) and scatter them
         into the given free slots. Requests are grouped by prompt-width
         bucket; each group prefills as one jitted call. Paged mode routes
         to `_insert_paged` (block allocation + prefix-store probing +
-        right-padded suffix prefill)."""
+        right-padded suffix prefill). Multi-tenant rows carry an adapter
+        id as a third element; the engine pins each row's adapter in the
+        store for the request's lifetime (released in `reclaim_slots`)
+        and the prefill program applies per-row factors."""
         assert len(rows) == len(slot_ids)
-        if self.kv_paging:
-            self._insert_paged(rows, slot_ids)
-            return
+        norm = [self._split_row(r) for r in rows]
+        aslots: Optional[List[int]] = None
+        if self.multi_tenant:
+            aslots = self._acquire_adapters(norm, slot_ids)
+        try:
+            if self.kv_paging:
+                self._insert_paged(norm, slot_ids, aslots)
+            else:
+                self._insert_dense(norm, slot_ids, aslots)
+        except Exception:
+            if self.multi_tenant:
+                self._release_adapters(slot_ids)
+            raise
+
+    def _acquire_adapters(self, norm, slot_ids) -> List[int]:
+        """Pin every row's adapter (loading on demand) and return their
+        stack indices. All-or-nothing: a capacity failure releases the
+        pins already taken so the scheduler can requeue the whole batch."""
+        aslots: List[int] = []
+        acquired: List[Tuple[int, Optional[str]]] = []
+        try:
+            for (ids, max_new, name), slot in zip(norm, slot_ids):
+                aslots.append(self.adapter_store.acquire(name))
+                acquired.append((int(slot), name))
+        except Exception:
+            for _, name in acquired:
+                self.adapter_store.release(name)
+            raise
+        for slot, name in acquired:
+            self._slot_adapter[slot] = name
+        return aslots
+
+    def _release_adapters(self, slots) -> None:
+        for slot in slots:
+            if int(slot) in self._slot_adapter:
+                self.adapter_store.release(self._slot_adapter.pop(int(slot)))
+
+    def _insert_dense(self, norm, slot_ids, aslots: Optional[List[int]]) -> None:
         pad_id = self.gen_cfg.pad_token_id
-        groups: Dict[int, List[Tuple[np.ndarray, int, int]]] = {}
-        for (ids, max_new), slot in zip(rows, slot_ids):
+        mt = self.multi_tenant
+        groups: Dict[int, List[Tuple[np.ndarray, int, int, int]]] = {}
+        for i, ((ids, max_new, _name), slot) in enumerate(zip(norm, slot_ids)):
             ids = self._check_row(ids, max_new)
             plen = _round_up(ids.size, self.prompt_bucket)
-            groups.setdefault(plen, []).append((ids, int(max_new), int(slot)))
+            groups.setdefault(plen, []).append(
+                (ids, int(max_new), int(slot), aslots[i] if mt else 0)
+            )
 
         params = self._current_params()
+        stack = self.adapter_store.stacked() if mt else None
         for plen, members in groups.items():
             for i in range(0, len(members), self.max_prefill_batch):
                 chunk = members[i : i + self.max_prefill_batch]
@@ -481,21 +584,34 @@ class InferenceEngine:
                 # rows are avoided) and scatter out of bounds
                 slots_arr = np.full((pb,), self.num_slots, np.int32)
                 max_new_arr = np.full((pb,), self.gen_cfg.max_new_tokens, np.int32)
-                for j, (ids, max_new, slot) in enumerate(chunk):
+                aidx_arr = np.zeros((pb,), np.int32)  # padding rows gather base
+                for j, (ids, max_new, slot, aslot) in enumerate(chunk):
                     ids_arr[j, plen - ids.size :] = ids  # left-padded (decode convention)
                     mask_arr[j, plen - ids.size :] = 1
                     slots_arr[j] = slot
                     max_new_arr[j] = max_new
+                    aidx_arr[j] = aslot
                 ids_arr[len(chunk) :] = ids_arr[0]
                 mask_arr[len(chunk) :] = mask_arr[0]
 
-                last_logits, cache = self._get_prefill(pb, plen)(
-                    params, jnp.asarray(ids_arr), jnp.asarray(mask_arr)
-                )
-                self._pool = self._get_insert(pb)(
-                    self._pool, cache, last_logits,
-                    jnp.asarray(slots_arr), jnp.asarray(max_new_arr),
-                )
+                if mt:
+                    aidx = jnp.asarray(aidx_arr)
+                    last_logits, cache = self._get_prefill(pb, plen)(
+                        params, jnp.asarray(ids_arr), jnp.asarray(mask_arr),
+                        stack, aidx,
+                    )
+                    self._pool = self._get_insert(pb)(
+                        self._pool, cache, last_logits,
+                        jnp.asarray(slots_arr), jnp.asarray(max_new_arr), aidx,
+                    )
+                else:
+                    last_logits, cache = self._get_prefill(pb, plen)(
+                        params, jnp.asarray(ids_arr), jnp.asarray(mask_arr)
+                    )
+                    self._pool = self._get_insert(pb)(
+                        self._pool, cache, last_logits,
+                        jnp.asarray(slots_arr), jnp.asarray(max_new_arr),
+                    )
 
     def _check_row(self, ids, max_new: int) -> np.ndarray:
         ids = np.asarray(ids, np.int32).reshape(-1)
@@ -510,10 +626,12 @@ class InferenceEngine:
             )
         return ids
 
-    def _insert_paged(self, rows, slot_ids) -> None:
+    def _insert_paged(self, rows, slot_ids, aslots: Optional[List[int]] = None) -> None:
         """Paged insert: allocate each request's blocks up front
         (prompt + max_new + spec_k — no mid-decode OOM, no preemption),
-        probing the prefix store for resident leading blocks first.
+        probing the prefix store for resident leading blocks first. In
+        multi-tenant mode prefix keys are salted with the row's adapter
+        identity, so paged prefix blocks never cross tenants.
 
         Requests whose probe would hit keys REGISTERED EARLIER IN THIS
         CALL are deferred one placement round: the registering request's
@@ -523,9 +641,14 @@ class InferenceEngine:
         prompt resolves as 1 full prefill + (n-1) suffix prefills batched
         together in round two."""
         bs, pool = self.kv_block_size, self._block_pool
-        pending: List[Tuple[np.ndarray, int, int]] = []
-        for (ids, max_new), slot in zip(rows, slot_ids):
-            pending.append((self._check_row(ids, max_new), int(max_new), int(slot)))
+        mt = self.multi_tenant
+        pending: List[Tuple[np.ndarray, int, int, bytes, int]] = []
+        for i, ((ids, max_new, name), slot) in enumerate(zip(rows, slot_ids)):
+            salt = adapter_salt(name) if mt else b""
+            pending.append((
+                self._check_row(ids, max_new), int(max_new), int(slot),
+                salt, aslots[i] if mt else 0,
+            ))
         params = self._current_params()
         # place every round before dispatching anything, journalling each
         # placement — on pool exhaustion the whole call rolls back (no
@@ -538,10 +661,10 @@ class InferenceEngine:
                 while pending:
                     placed, deferred = [], []
                     round_keys: set = set()
-                    for ids, max_new, slot in pending:
-                        keys = prefix_keys(ids, bs) if self.prefix_cache else []
+                    for ids, max_new, slot, salt, aslot in pending:
+                        keys = prefix_keys(ids, bs, salt) if self.prefix_cache else []
                         if any(k in round_keys for k in keys):
-                            deferred.append((ids, max_new, slot))
+                            deferred.append((ids, max_new, slot, salt, aslot))
                             continue
                         shared: List[int] = []
                         for key in keys:
@@ -572,7 +695,7 @@ class InferenceEngine:
                         self._slot_blocks[slot] = blocks
                         journal.append((slot, blocks, registered))
                         T = len(shared) * bs
-                        placed.append((ids[T:], T, blocks, max_new, slot))
+                        placed.append((ids[T:], T, blocks, max_new, slot, aslot))
                     rounds.append(placed)
                     pending = deferred
             except KVPoolExhaustedError:
@@ -592,6 +715,8 @@ class InferenceEngine:
         """Dispatch one placement round's prefills, grouped by suffix
         width bucket and chunked to `max_prefill_batch`."""
         pad_id = self.gen_cfg.pad_token_id
+        mt = self.multi_tenant
+        stack = self.adapter_store.stacked() if mt else None
         groups: Dict[int, List] = {}
         for item in placed:
             plen = _round_up(len(item[0]), self.prompt_bucket)
@@ -606,7 +731,8 @@ class InferenceEngine:
                 slots_arr = np.full((pb,), self.num_slots, np.int32)
                 max_new_arr = np.full((pb,), self.gen_cfg.max_new_tokens, np.int32)
                 shared_arr = np.zeros((pb,), np.int32)
-                for j, (suffix, T, blocks, max_new, slot) in enumerate(chunk):
+                aidx_arr = np.zeros((pb,), np.int32)  # padding rows gather base
+                for j, (suffix, T, blocks, max_new, slot, aslot) in enumerate(chunk):
                     ids_arr[j, : len(suffix)] = suffix  # RIGHT-padded
                     tmask[j, : len(suffix)] = 1
                     tables[j, : len(blocks)] = blocks
@@ -614,15 +740,19 @@ class InferenceEngine:
                     slots_arr[j] = slot
                     max_new_arr[j] = max_new
                     shared_arr[j] = T
+                    aidx_arr[j] = aslot
                 # padding rows repeat row 0's tokens but keep all-OOB
                 # tables and OOB slot ids — every write they make drops
                 ids_arr[len(chunk) :] = ids_arr[0]
                 tmask[len(chunk) :] = tmask[0]
-                self._pool = self._get_paged_insert(pb, plen)(
+                args = [
                     self._pool, params, jnp.asarray(ids_arr), jnp.asarray(tmask),
                     jnp.asarray(tables), jnp.asarray(slots_arr),
                     jnp.asarray(max_new_arr), jnp.asarray(shared_arr),
-                )
+                ]
+                if mt:
+                    args += [stack, jnp.asarray(aidx_arr)]
+                self._pool = self._get_paged_insert(pb, plen)(*args)
 
     # ------------------------------------------------------------------
     # Decode
@@ -633,8 +763,9 @@ class InferenceEngine:
         pad, eos = gen_cfg.pad_token_id, gen_cfg.eos_token_id
         sample_fused = self._sample_fused
         paged = self.kv_paging
+        mt = self.multi_tenant
 
-        def decode(params, pool):
+        def decode(params, pool, stack=None):
             params = dequantize_tree(params)
             active = pool["active"].astype(bool)
             # emit the token the PREVIOUS program (insert or decode)
@@ -653,8 +784,14 @@ class InferenceEngine:
                 cache["layers"] = [
                     dict(al, table=pool["table"]) for al in cache["layers"]
                 ]
+            variables = {"params": params}
+            if mt:
+                # one heterogeneous step: each row applies its own
+                # adapter's factors, gathered by the slot's stack index
+                # (Punica-style batched LoRA; slot 0 zeros = base policy)
+                variables["lora_rows"] = _gather_rows(stack, pool["adapter"])
             logits, new_cache = model.apply(
-                {"params": params}, token[:, None], cache,
+                variables, token[:, None], cache,
                 valid.astype(jnp.int32)[:, None],
                 method=type(model).decode_step_rows,
             )
@@ -878,6 +1015,11 @@ class InferenceEngine:
             self._pool, token, logprob, valid, finished = self._decode_fn(
                 params, self._pool, head[0], head[1]
             )
+        elif self.multi_tenant:
+            params = self._current_params()
+            self._pool, token, logprob, valid, finished = self._decode_fn(
+                params, self._pool, self.adapter_store.stacked()
+            )
         else:
             params = self._current_params()
             self._pool, token, logprob, valid, finished = self._decode_fn(params, self._pool)
@@ -898,11 +1040,13 @@ class InferenceEngine:
         self.reclaim_slots(slots)
 
     def reclaim_slots(self, slots: Sequence[int]) -> None:
-        """Return a finished slot's blocks to the pool (host bookkeeping
-        only — no device op; a freed slot's stale table is harmless
-        because inactive rows' arena writes are gated out). Idempotent;
-        a no-op when paging is off. The scheduler calls this for natural
+        """Return a finished slot's blocks to the pool and drop its
+        adapter pin (host bookkeeping only — no device op; a freed slot's
+        stale table is harmless because inactive rows' arena writes are
+        gated out). Idempotent; the scheduler calls this for natural
         finishes; `release_slots` folds it into cancels."""
+        if self.multi_tenant:
+            self._release_adapters(slots)
         if not self.kv_paging:
             return
         with self._kv_lock:
@@ -916,17 +1060,19 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def projected_blocks(
-        self, prompt_ids, max_new_tokens: int, ignore_cache: bool = False
+        self, prompt_ids, max_new_tokens: int, ignore_cache: bool = False,
+        adapter_id: Optional[str] = None,
     ) -> int:
         """Blocks this request would claim if admitted now:
         ceil((prompt + max_new + spec_k) / block_size) minus the leading
-        blocks a read-only prefix-store probe says are resident. 0 when
-        paging is off."""
+        blocks a read-only prefix-store probe says are resident (probed
+        in the request's own adapter key space). 0 when paging is off."""
         if not self.kv_paging:
             return 0
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        salt = adapter_salt(adapter_id) if self.multi_tenant else b""
         with self._kv_lock:
-            shared = 0 if ignore_cache else self._block_pool.lookup_chain(ids)
+            shared = 0 if ignore_cache else self._block_pool.lookup_chain(ids, salt)
         n_cap = -(-(ids.size + int(max_new_tokens) + self.spec_k) // self.kv_block_size)
         return max(1, n_cap - shared)
 
@@ -970,6 +1116,34 @@ class InferenceEngine:
                 "prefix_cache_idle_blocks": pool.cached_idle(),
             }
 
+    # ------------------------------------------------------------------
+    # Multi-tenant adapter plumbing
+    # ------------------------------------------------------------------
+
+    def flush_adapter_prefixes(self, name: Optional[str]) -> int:
+        """Drop one adapter's cached prefix blocks (per-adapter
+        hot-reload: its K/V went stale, everyone else's is still good).
+        Returns the number of keys flushed; 0 when prefix caching is off."""
+        if not self.prefix_cache:
+            return 0
+        with self._kv_lock:
+            return self._block_pool.flush_prefix(adapter_salt(name))
+
+    def adapter_stats(self) -> Dict[str, Any]:
+        """Store counters for metrics/healthz; {} when single-tenant."""
+        return self.adapter_store.stats() if self.multi_tenant else {}
+
+    def slots_for_adapter(self, name: Optional[str]) -> List[int]:
+        """Slots currently pinned to `name` (per-adapter drain)."""
+        return [s for s, n in self._slot_adapter.items() if n == name]
+
     @property
     def active_slots(self) -> int:
-        return int(np.asarray(self._pool["active"]).sum())
+        try:
+            n = int(np.asarray(self._pool["active"]).sum())
+        except RuntimeError:
+            # a jitted step donated the pool out from under this reader
+            # (healthz probe racing decode) — serve the last observed count
+            return getattr(self, "_active_snapshot", self.num_slots)
+        self._active_snapshot = n
+        return n

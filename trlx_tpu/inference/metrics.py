@@ -4,10 +4,28 @@ Counters, gauges, and fixed-bucket histograms behind one lock, rendered
 in the Prometheus exposition format by `render()` — enough for a scrape
 target without pulling in prometheus_client. Metric names are
 namespaced `trlx_tpu_inference_*` at render time.
+
+Labeled series: every write accepts an optional ``labels`` dict and the
+registry stores the series under its full exposition name
+(``name{k="v"}``, labels sorted) — one TYPE line per base name, one
+sample line per label combination. The unlabeled API is the labels=None
+case, unchanged.
 """
 
 import threading
 from typing import Dict, List, Optional, Tuple
+
+def _series(name: str, labels: Optional[Dict[str, str]]) -> str:
+    """Full exposition-format series name. Labels render sorted so the
+    same logical series always maps to the same registry key; values are
+    escaped per the Prometheus text format."""
+    if not labels:
+        return name
+    parts = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+        parts.append(f'{k}="{v}"')
+    return name + "{" + ",".join(parts) + "}"
 
 # log-ish spaced latency buckets: 1ms .. 60s
 DEFAULT_BUCKETS = (
@@ -47,29 +65,34 @@ class InferenceMetrics:
         # instantaneous throughput: EWMA over decode steps
         self._tokens_per_s = 0.0
 
-    def inc(self, name: str, by: float = 1.0) -> None:
-        self.add(name, by)
+    def inc(self, name: str, by: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+        self.add(name, by, labels=labels)
 
-    def add(self, name: str, by: float) -> None:
+    def add(self, name: str, by: float, labels: Optional[Dict[str, str]] = None) -> None:
+        name = _series(name, labels)
         with self._lock:
             self._counters[name] = self._counters.get(name, 0.0) + by
 
-    def set_counter(self, name: str, value: float) -> None:
+    def set_counter(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
         """Sync a counter to an absolute value — for tallies whose source
         of truth lives elsewhere (the engine's KV block pool) and are
         mirrored into the registry rather than accumulated here."""
+        name = _series(name, labels)
         with self._lock:
             self._counters[name] = float(value)
 
-    def set_gauge(self, name: str, value: float) -> None:
+    def set_gauge(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        name = _series(name, labels)
         with self._lock:
             self._gauges[name] = float(value)
 
-    def get(self, name: str) -> float:
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None) -> float:
+        name = _series(name, labels)
         with self._lock:
             return self._counters.get(name, self._gauges.get(name, 0.0))
 
-    def observe(self, name: str, value: float) -> None:
+    def observe(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        name = _series(name, labels)
         with self._lock:
             if name not in self._hists:
                 self._hists[name] = _Histogram()
@@ -88,9 +111,12 @@ class InferenceMetrics:
         """Prometheus text exposition."""
         lines: List[str] = []
         with self._lock:
+            seen_gauge_types = set()
             for name, value in sorted(self._gauges.items()):
                 base = name.split("{")[0]
-                lines.append(f"# TYPE {NAMESPACE}_{base} gauge")
+                if base not in seen_gauge_types:
+                    seen_gauge_types.add(base)
+                    lines.append(f"# TYPE {NAMESPACE}_{base} gauge")
                 lines.append(f"{NAMESPACE}_{name} {value}")
             seen_types = set()
             for name, value in sorted(self._counters.items()):
@@ -99,14 +125,22 @@ class InferenceMetrics:
                     seen_types.add(base)
                     lines.append(f"# TYPE {NAMESPACE}_{base} counter")
                 lines.append(f"{NAMESPACE}_{name} {value}")
+            seen_hist_types = set()
             for name, h in sorted(self._hists.items()):
-                lines.append(f"# TYPE {NAMESPACE}_{name} histogram")
+                # labeled histograms fold `le` into the series' own label
+                # set (base{k="v",le="..."}); unlabeled keep the plain form
+                base, brace, label_body = name.partition("{")
+                label_prefix = label_body[:-1] + "," if brace else ""
+                if base not in seen_hist_types:
+                    seen_hist_types.add(base)
+                    lines.append(f"# TYPE {NAMESPACE}_{base} histogram")
                 cum = 0
                 for edge, c in zip(h.buckets, h.counts):
                     cum += c
-                    lines.append(f'{NAMESPACE}_{name}_bucket{{le="{edge}"}} {cum}')
+                    lines.append(f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="{edge}"}} {cum}')
                 cum += h.counts[-1]
-                lines.append(f'{NAMESPACE}_{name}_bucket{{le="+Inf"}} {cum}')
-                lines.append(f"{NAMESPACE}_{name}_sum {h.total}")
-                lines.append(f"{NAMESPACE}_{name}_count {h.n}")
+                lines.append(f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="+Inf"}} {cum}')
+                suffix = "{" + label_body if brace else ""
+                lines.append(f"{NAMESPACE}_{base}_sum{suffix} {h.total}")
+                lines.append(f"{NAMESPACE}_{base}_count{suffix} {h.n}")
         return "\n".join(lines) + "\n"

@@ -46,15 +46,23 @@ class KVPoolExhaustedError(RuntimeError):
         )
 
 
-def prefix_keys(ids: np.ndarray, block_size: int) -> List[bytes]:
+def prefix_keys(ids: np.ndarray, block_size: int, salt: bytes = b"") -> List[bytes]:
     """Chained prefix keys for a prompt: key j covers tokens
     [0, (j+1)*block_size). Only FULL blocks are keyed, and the last block
     is excluded when the prompt ends exactly on a boundary — at least one
     suffix token must always prefill, so the engine never has to store
-    last-position logits alongside cached blocks."""
+    last-position logits alongside cached blocks.
+
+    `salt` partitions the store: multi-tenant serving salts keys with the
+    adapter identity so identical prompts under different adapters never
+    share K/V (each adapter's K/V differs once a LoRA delta touches
+    k_proj/v_proj, and cross-tenant sharing would leak prompt contents
+    through cache timing regardless). Salts are self-delimiting (the
+    adapter name is NUL-terminated), so one salt can never be a byte
+    prefix of another and per-salt flushes can match on startswith."""
     ids = np.asarray(ids, np.int32).reshape(-1)
     limit = (ids.size - 1) // block_size
-    return [ids[: (j + 1) * block_size].tobytes() for j in range(limit)]
+    return [salt + ids[: (j + 1) * block_size].tobytes() for j in range(limit)]
 
 
 class BlockPool:
@@ -104,13 +112,13 @@ class BlockPool:
     def refcount(self, block: int) -> int:
         return self._ref.get(block, 0)
 
-    def lookup_chain(self, ids: np.ndarray) -> int:
+    def lookup_chain(self, ids: np.ndarray, salt: bytes = b"") -> int:
         """Read-only probe: how many leading blocks of this prompt the
         store could serve right now (admission projections)."""
         if not self.prefix_cache:
             return 0
         n = 0
-        for key in prefix_keys(ids, self.block_size):
+        for key in prefix_keys(ids, self.block_size, salt):
             if key not in self._store:
                 break
             n += 1
@@ -191,6 +199,19 @@ class BlockPool:
         self._idle.clear()
         self._store.clear()
         self._key_of.clear()
+
+    def flush_prefix(self, salt: bytes) -> int:
+        """Forget every stored prefix under one salt (per-adapter
+        hot-reload: only that adapter's cached K/V went stale). Same
+        holder semantics as flush_cached, scoped to keys carrying the
+        salt. Returns the number of keys dropped."""
+        doomed = [key for key in self._store if key.startswith(salt)]
+        for key in doomed:
+            block = self._store.pop(key)
+            self._key_of.pop(block, None)
+            if self._idle.pop(key, None) is not None:
+                self._free.append(block)
+        return len(doomed)
 
     def _evict_oldest(self) -> int:
         key, block = self._idle.popitem(last=False)

@@ -11,7 +11,14 @@ FIFO admission into free KV-cache slots with:
   pool admits immediately;
 - **per-request deadlines** — requests expire both in the queue and
   mid-flight; expired in-flight requests release their slot for the
-  next admission.
+  next admission;
+- **fair-share admission** (`fair_share=True`, multi-tenant serving) —
+  weighted deficit round-robin over per-tenant demand replaces the
+  strict FIFO pop: each admission round tops every queued tenant's
+  deficit up by its weight and serves requests against those deficits,
+  so one hot tenant can saturate spare capacity but can never starve
+  the rest below their weight share. Per-tenant queue-depth caps bound
+  how much backlog any single tenant can park (503 + Retry-After).
 
 The driver loop runs on one daemon thread (JAX dispatch is kept
 single-threaded); HTTP handler threads only touch the queue under the
@@ -23,10 +30,11 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from trlx_tpu.inference.adapters import AdapterError
 from trlx_tpu.inference.metrics import InferenceMetrics
 from trlx_tpu.inference.paging import KVPoolExhaustedError
 from trlx_tpu.utils import logging
@@ -63,6 +71,7 @@ class InferenceRequest:
     prompt_ids: np.ndarray
     max_new_tokens: int
     deadline: Optional[float]  # absolute time.monotonic()
+    adapter_id: Optional[str] = None  # multi-tenant: None = base policy
     enqueue_time: float = field(default_factory=time.monotonic)
     token_ids: List[int] = field(default_factory=list)
     # per-token policy logprobs (raw-logit log-softmax at each emitted
@@ -96,12 +105,22 @@ class Scheduler:
         max_wait_s: float = 0.01,
         default_deadline_s: Optional[float] = None,
         metrics: Optional[InferenceMetrics] = None,
+        fair_share: bool = False,
+        tenant_weights: Optional[Dict[str, float]] = None,
+        tenant_queue_depth: int = 0,
     ):
         self.engine = engine
         self.max_queue_depth = int(max_queue_depth)
         self.max_wait_s = float(max_wait_s)
         self.default_deadline_s = default_deadline_s
         self.metrics = metrics or InferenceMetrics(engine.num_slots)
+        self.fair_share = bool(fair_share)
+        # priority classes: admission shares are proportional to weight
+        # (unlisted tenants get weight 1.0); 0 = no per-tenant depth cap
+        self.tenant_weights = dict(tenant_weights or {})
+        self.tenant_queue_depth = int(tenant_queue_depth)
+        self._deficit: Dict[str, float] = {}  # WDRR state, tenants with demand
+        self._blocked_tenants: Set[str] = set()  # per-adapter drain gates
         self._queue: Deque[InferenceRequest] = deque()
         self._cond = threading.Condition()
         self._slot_req: Dict[int, InferenceRequest] = {}
@@ -119,7 +138,21 @@ class Scheduler:
     # Client surface (any thread)
     # ------------------------------------------------------------------
 
-    def _validate(self, prompt_ids, max_new_tokens: Optional[int]):
+    @staticmethod
+    def _tenant(req_or_name) -> str:
+        name = getattr(req_or_name, "adapter_id", req_or_name)
+        return name if name else "base"
+
+    def _validate(self, prompt_ids, max_new_tokens: Optional[int],
+                  adapter_id: Optional[str] = None):
+        if adapter_id is not None:
+            if not getattr(self.engine, "multi_tenant", False):
+                raise ValueError(
+                    "adapter_id requires an engine built with "
+                    "inference.multi_tenant"
+                )
+            if not self.engine.adapter_store.known(adapter_id):
+                raise ValueError(f"unknown adapter '{adapter_id}'")
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
@@ -172,6 +205,18 @@ class Scheduler:
                 raise QueueFullError(
                     len(self._queue), retry_after=self._predicted_retry_after()
                 )
+            if self.tenant_queue_depth:
+                tenant = self._tenant(reqs[0])
+                depth = sum(1 for r in self._queue if self._tenant(r) == tenant)
+                if depth + len(reqs) > self.tenant_queue_depth:
+                    self.metrics.inc("requests_rejected_total", len(reqs))
+                    self.metrics.inc(
+                        "adapter_requests_rejected_total", len(reqs),
+                        labels={"adapter": tenant},
+                    )
+                    raise QueueFullError(
+                        depth, retry_after=self._predicted_retry_after()
+                    )
             self._queue.extend(reqs)
             self.metrics.set_gauge("queue_depth", len(self._queue))
             self._cond.notify_all()
@@ -181,14 +226,16 @@ class Scheduler:
         prompt_ids,
         max_new_tokens: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        adapter_id: Optional[str] = None,
     ) -> InferenceRequest:
-        ids, max_new = self._validate(prompt_ids, max_new_tokens)
+        ids, max_new = self._validate(prompt_ids, max_new_tokens, adapter_id)
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = InferenceRequest(
             id=next(self._ids),
             prompt_ids=ids,
             max_new_tokens=max_new,
             deadline=(time.monotonic() + dl) if dl else None,
+            adapter_id=adapter_id,
         )
         self._enqueue([req])
         return req
@@ -199,6 +246,7 @@ class Scheduler:
         n: int,
         max_new_tokens: Optional[int] = None,
         deadline_s: Optional[float] = None,
+        adapter_id: Optional[str] = None,
     ) -> List[InferenceRequest]:
         """GRPO-style fan-out: enqueue `n` independent generations of one
         prompt as ADJACENT queue entries under one lock, so the paged
@@ -207,7 +255,7 @@ class Scheduler:
         the prompt's KV blocks. All-or-nothing against queue depth."""
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
-        ids, max_new = self._validate(prompt_ids, max_new_tokens)
+        ids, max_new = self._validate(prompt_ids, max_new_tokens, adapter_id)
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         deadline = (time.monotonic() + dl) if dl else None
         reqs = [
@@ -216,6 +264,7 @@ class Scheduler:
                 prompt_ids=ids,
                 max_new_tokens=max_new,
                 deadline=deadline,
+                adapter_id=adapter_id,
             )
             for _ in range(n)
         ]
@@ -223,9 +272,9 @@ class Scheduler:
         return reqs
 
     def generate(self, prompt_ids, max_new_tokens=None, deadline_s=None,
-                 timeout: Optional[float] = None) -> InferenceRequest:
+                 timeout: Optional[float] = None, adapter_id=None) -> InferenceRequest:
         """Blocking submit + wait convenience (tests, in-process callers)."""
-        req = self.submit(prompt_ids, max_new_tokens, deadline_s)
+        req = self.submit(prompt_ids, max_new_tokens, deadline_s, adapter_id)
         req.wait(timeout)
         return req
 
@@ -291,6 +340,28 @@ class Scheduler:
             time.sleep(0.005)
         with self._cond:
             return not self._slot_req
+
+    def drain_tenant(self, adapter_id: Optional[str], timeout_s: float = 30.0) -> bool:
+        """Block ONE tenant's admission and wait until none of its
+        requests are in flight (per-adapter hot-reload: the other
+        tenants keep decoding and admitting throughout). Caller must
+        `resume_tenant` after. Returns False on timeout."""
+        tenant = self._tenant(adapter_id)
+        with self._cond:
+            self._blocked_tenants.add(tenant)
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not any(self._tenant(r) == tenant for r in self._slot_req.values()):
+                    return True
+            time.sleep(0.005)
+        with self._cond:
+            return not any(self._tenant(r) == tenant for r in self._slot_req.values())
+
+    def resume_tenant(self, adapter_id: Optional[str]) -> None:
+        with self._cond:
+            self._blocked_tenants.discard(self._tenant(adapter_id))
+            self._cond.notify_all()
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -363,6 +434,62 @@ class Scheduler:
         for req in expired:
             self._finish_request(req, "deadline")
 
+    def _weight(self, tenant: str) -> float:
+        return max(float(self.tenant_weights.get(tenant, 1.0)), 1e-6)
+
+    def _pop_weighted(self, paged: bool, budget: int):
+        """Weighted deficit round-robin pop (called under self._cond).
+
+        Each tenant carries a deficit counter topped up by its weight
+        whenever no tenant can afford an admission; admitting one request
+        costs one deficit unit. The max-deficit tenant goes first, so over
+        time tenants are served proportionally to their weights no matter
+        how lopsided the arrival rates are. Tenants in `_blocked_tenants`
+        (mid hot-reload drain) and tenants whose head request does not fit
+        the paged block budget are skipped *without* stalling the others —
+        unlike the FIFO path, one tenant's oversized head cannot convoy
+        the whole queue."""
+        batch: List[InferenceRequest] = []
+        slots: List[int] = []
+        skipped: Set[str] = set()  # blocked on block budget this round
+        while self._queue and self._free:
+            tenants: List[str] = []
+            for req in self._queue:
+                t = self._tenant(req)
+                if t not in tenants and t not in skipped and t not in self._blocked_tenants:
+                    tenants.append(t)
+            if not tenants:
+                break
+            affordable = [t for t in tenants if self._deficit.get(t, 0.0) >= 1.0]
+            if not affordable:
+                for t in tenants:
+                    self._deficit[t] = self._deficit.get(t, 0.0) + self._weight(t)
+                affordable = [t for t in tenants if self._deficit.get(t, 0.0) >= 1.0]
+                if not affordable:
+                    continue  # weights > 0 guarantee progress
+            pick = max(affordable, key=lambda t: self._deficit.get(t, 0.0))
+            req = next(r for r in self._queue if self._tenant(r) == pick)
+            if paged:
+                need = self.engine.projected_blocks(
+                    req.prompt_ids, req.max_new_tokens, adapter_id=req.adapter_id
+                ) if getattr(self.engine, "multi_tenant", False) else (
+                    self.engine.projected_blocks(req.prompt_ids, req.max_new_tokens)
+                )
+                if need > budget:
+                    skipped.add(pick)  # this tenant waits; others may still fit
+                    continue
+                budget -= need
+            self._queue.remove(req)
+            self._deficit[pick] = self._deficit.get(pick, 0.0) - 1.0
+            batch.append(req)
+            slots.append(self._free.pop())
+        # deficits are only meaningful while a tenant has backlog: reset
+        # drained tenants so an idle tenant cannot bank unbounded credit
+        live = {self._tenant(r) for r in self._queue}
+        for t in [t for t in self._deficit if t not in live]:
+            del self._deficit[t]
+        return batch, slots, budget
+
     def _admit(self) -> None:
         with self._cond:
             if self._paused or not self._queue or not self._free:
@@ -378,30 +505,38 @@ class Scheduler:
             paged = getattr(self.engine, "kv_paging", False)
             budget = self.engine.blocks_available() if paged else 0
             batch, slots = [], []
-            while self._queue and self._free:
-                if paged:
-                    head = self._queue[0]
-                    need = self.engine.projected_blocks(
-                        head.prompt_ids, head.max_new_tokens
-                    )
-                    if need > budget:
-                        break  # FIFO head waits until decodes free blocks
-                    budget -= need
-                batch.append(self._queue.popleft())
-                slots.append(self._free.pop())
+            if self.fair_share or self._blocked_tenants:
+                batch, slots, budget = self._pop_weighted(paged, budget)
+            else:
+                while self._queue and self._free:
+                    if paged:
+                        head = self._queue[0]
+                        need = self.engine.projected_blocks(
+                            head.prompt_ids, head.max_new_tokens
+                        )
+                        if need > budget:
+                            break  # FIFO head waits until decodes free blocks
+                        budget -= need
+                    batch.append(self._queue.popleft())
+                    slots.append(self._free.pop())
             if not batch:
                 return
             self.metrics.set_gauge("queue_depth", len(self._queue))
         t0 = time.perf_counter()
+        multi_tenant = getattr(self.engine, "multi_tenant", False)
+        rows = (
+            [(r.prompt_ids, r.max_new_tokens, r.adapter_id) for r in batch]
+            if multi_tenant
+            else [(r.prompt_ids, r.max_new_tokens) for r in batch]
+        )
         try:
-            self.engine.insert_requests(
-                [(r.prompt_ids, r.max_new_tokens) for r in batch], slots
-            )
-        except KVPoolExhaustedError:
+            self.engine.insert_requests(rows, slots)
+        except (KVPoolExhaustedError, AdapterError):
             # projection raced block state (e.g. an idle cached block the
-            # probe counted as shared got evicted mid-placement); the
-            # engine rolled the whole call back — requeue in order and
-            # retry once blocks free
+            # probe counted as shared got evicted mid-placement), or every
+            # adapter slot is pinned by in-flight requests; the engine
+            # rolled the whole call back — requeue in order and retry
+            # once blocks / adapter slots free
             with self._cond:
                 self._queue.extendleft(reversed(batch))
                 self._free.extend(slots)
@@ -434,6 +569,8 @@ class Scheduler:
             logprobs = logprobs[:, None]
             valid = valid[:, None]
         spec = getattr(self.engine, "spec_k", 0) > 0
+        multi_tenant = getattr(self.engine, "multi_tenant", False)
+        tenant_emitted: Dict[str, int] = {}
         emitted = 0
         now = time.monotonic()
         eos = self.engine.gen_cfg.eos_token_id
@@ -445,6 +582,9 @@ class Scheduler:
                     req.token_logprobs.append(float(logprobs[slot, j]))
                     n_slot += 1
             emitted += n_slot
+            if multi_tenant and n_slot:
+                t = self._tenant(req)
+                tenant_emitted[t] = tenant_emitted.get(t, 0) + n_slot
             if spec and n_slot:
                 # accept-length per slot per speculative round (1 pending
                 # + accepted drafts) — the serving-side mirror of the
@@ -461,6 +601,10 @@ class Scheduler:
                 self._release(slot)
                 self._finish_request(req, "deadline")
         self.metrics.add("tokens_generated_total", emitted)
+        for t, n in tenant_emitted.items():
+            self.metrics.add(
+                "adapter_tokens_generated_total", n, labels={"adapter": t}
+            )
         self.metrics.record_token_rate(emitted, dt)
         self._sync_kv_metrics()
 
@@ -468,6 +612,15 @@ class Scheduler:
         """Mirror the engine's block-pool tallies into the Prometheus
         registry (gauges for occupancy, absolute-synced counters for the
         prefix cache — the pool is the source of truth)."""
+        store = getattr(self.engine, "adapter_store", None)
+        if store is not None and getattr(self.engine, "multi_tenant", False):
+            astats = store.stats()
+            self.metrics.set_gauge("adapters_resident", len(astats["resident"]))
+            self.metrics.set_gauge("adapters_capacity", astats["capacity"])
+            self.metrics.set_gauge("adapter_resident_bytes", astats["resident_bytes"])
+            self.metrics.set_counter("adapter_loads_total", astats["loads"])
+            self.metrics.set_counter("adapter_evictions_total", astats["evictions"])
+            self.metrics.set_counter("adapter_reloads_total", astats["reloads"])
         stats = self.engine.kv_stats() if hasattr(self.engine, "kv_stats") else {}
         if not stats:
             return
@@ -493,4 +646,16 @@ class Scheduler:
         self.metrics.inc(f'requests_total{{outcome="{reason}"}}')
         if req.latency_s is not None:
             self.metrics.observe("request_latency_seconds", req.latency_s)
+        if getattr(self.engine, "multi_tenant", False):
+            tenant = self._tenant(req)
+            self.metrics.inc(
+                "adapter_requests_total",
+                labels={"adapter": tenant, "outcome": reason},
+            )
+            if req.latency_s is not None:
+                self.metrics.observe(
+                    "adapter_request_latency_seconds",
+                    req.latency_s,
+                    labels={"adapter": tenant},
+                )
         req._done.set()

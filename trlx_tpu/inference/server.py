@@ -8,14 +8,22 @@ dependency-free `ThreadingHTTPServer`, JSON in/out, and an optional
 Endpoints:
 
 - ``POST /generate`` — ``{"prompt": str}`` or ``{"prompt_ids": [...]}``
-  plus optional ``max_new_tokens`` / ``deadline_s``. Answers
+  plus optional ``max_new_tokens`` / ``deadline_s`` / ``adapter_id``
+  (multi-tenant serving: which LoRA adapter decodes this request;
+  omitted = the base policy). Answers
   ``{"id", "text", "token_ids", "finish_reason", "latency_s"}``.
   Backpressure: a full queue answers **503 with a Retry-After header**
   (the shared HTTP client retries those transparently); an expired
   deadline answers **504**.
-- ``GET /healthz`` — liveness + slot/queue/reload snapshot.
+- ``GET /healthz`` — liveness + slot/queue/reload snapshot (plus the
+  resident adapter set on multi-tenant servers, which fleet routers use
+  for adapter affinity).
 - ``GET /metrics`` — Prometheus text: queue depth, slot occupancy,
-  prefill/decode/request latency histograms, tokens/sec.
+  prefill/decode/request latency histograms, tokens/sec (per-adapter
+  labeled series on multi-tenant servers).
+- ``GET/POST /admin/adapters`` — multi-tenant control plane: GET lists
+  resident + on-disk adapters and store stats; POST takes one of
+  ``{"load": name}`` / ``{"evict": name}`` / ``{"reload": name}``.
 
 Hot-reload: with `watch_dir` set, a daemon thread polls for the newest
 **manifest-complete** checkpoint (PR 1's `resilience` validation — a
@@ -35,6 +43,7 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from trlx_tpu import resilience
+from trlx_tpu.inference.adapters import AdapterError
 from trlx_tpu.inference.scheduler import DrainingError, QueueFullError, Scheduler
 from trlx_tpu.utils import logging
 
@@ -144,12 +153,66 @@ class CheckpointWatcher(threading.Thread):
         logger.info(f"hot-reload: serving checkpoint {path} (step {step})")
         return True
 
+    # -- per-adapter hot-reload (multi-tenant serving) ------------------
+
+    def poll_adapters(self) -> int:
+        """Scan the adapter store for resident adapters whose on-disk
+        checkpoint moved and hot-reload each — the per-tenant analogue of
+        `poll_once`, draining only that adapter's slots instead of the
+        whole replica. Returns the number of adapters swapped."""
+        store = getattr(self.engine, "adapter_store", None)
+        if store is None:
+            return 0
+        swapped = 0
+        for name in store.changed():
+            if self.reload_adapter(name):
+                swapped += 1
+        return swapped
+
+    def reload_adapter(self, name: str) -> bool:
+        """Drain-swap ONE adapter: admission for that tenant pauses, its
+        in-flight requests decode to completion, the factors re-read into
+        the same stack slot (fixed shape — no recompile) and its salted
+        prefix blocks flush (cached K/V was computed under the old
+        factors). Other tenants keep decoding throughout. Returns False
+        when the on-disk version already matches."""
+        store = self.engine.adapter_store
+        if self.scheduler is not None:
+            if not self.scheduler.drain_tenant(name, self.drain_timeout_s):
+                logger.warning(
+                    f"adapter hot-reload: drain of '{name}' timed out after "
+                    f"{self.drain_timeout_s}s; deferring to the next poll"
+                )
+                self.scheduler.resume_tenant(name)
+                return False
+        try:
+            try:
+                reloaded = store.reload(name)
+            except Exception as e:
+                logger.warning(f"adapter hot-reload: failed for '{name}': {e}")
+                return False
+            if reloaded:
+                self.engine.flush_adapter_prefixes(name)
+                if self.metrics is not None:
+                    self.metrics.inc(
+                        "adapter_reload_events_total", labels={"adapter": str(name)}
+                    )
+                logger.info(f"adapter hot-reload: '{name}' serving new factors")
+            return reloaded
+        finally:
+            if self.scheduler is not None:
+                self.scheduler.resume_tenant(name)
+
     def run(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
                 self.poll_once()
             except Exception:  # pragma: no cover - keep watching
                 logger.exception("checkpoint watcher scan failed")
+            try:
+                self.poll_adapters()
+            except Exception:  # pragma: no cover - keep watching
+                logger.exception("adapter watcher scan failed")
 
     def stop(self) -> None:
         self._stop.set()
@@ -229,7 +292,8 @@ class InferenceServer:
         else:
             raise ValueError("payload needs 'prompt' or 'prompt_ids'")
         unsupported = set(payload) - {
-            "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n"
+            "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n",
+            "adapter_id",
         }
         if unsupported:
             raise ValueError(
@@ -237,11 +301,13 @@ class InferenceServer:
                 "knobs are fixed at server start (inference.gen_kwargs)"
             )
         n = int(payload.get("n", 1))
+        adapter_id = payload.get("adapter_id")
         if n == 1:
             reqs = [self.scheduler.submit(
                 ids,
                 max_new_tokens=payload.get("max_new_tokens"),
                 deadline_s=payload.get("deadline_s"),
+                adapter_id=adapter_id,
             )]
         else:
             # GRPO-style fan-out: one prompt, n independent completions —
@@ -251,6 +317,7 @@ class InferenceServer:
                 ids, n,
                 max_new_tokens=payload.get("max_new_tokens"),
                 deadline_s=payload.get("deadline_s"),
+                adapter_id=adapter_id,
             )
         for req in reqs:
             req.wait()
@@ -319,7 +386,43 @@ class InferenceServer:
                 "checkpoint_step": self._effective_checkpoint_step(),
                 "reloads": self.watcher.reloads,
             }
+        if path == "/admin/adapters":
+            store = self._adapter_store(required=True)
+            actions = [k for k in ("load", "evict", "reload") if k in payload]
+            if len(actions) != 1:
+                raise ValueError(
+                    "POST /admin/adapters takes exactly one of "
+                    '{"load": name} / {"evict": name} / {"reload": name}'
+                )
+            action, name = actions[0], str(payload[actions[0]])
+            out: Dict[str, Any] = {"action": action, "adapter": name}
+            if action == "load":
+                out["slot"] = store.load(name)
+            elif action == "evict":
+                store.evict(name)
+                self.engine.flush_adapter_prefixes(name)
+            else:  # reload
+                out["reloaded"] = self.watcher.reload_adapter(name)
+            out.update(self._adapter_snapshot())
+            return out
         raise ValueError(f"unknown admin endpoint {path}")
+
+    def _adapter_store(self, required: bool = False):
+        store = getattr(self.engine, "adapter_store", None)
+        if store is None and required:
+            raise ValueError(
+                "server is not multi-tenant (start with inference.multi_tenant "
+                "and an adapter_dir)"
+            )
+        return store
+
+    def _adapter_snapshot(self) -> Dict:
+        store = self._adapter_store(required=True)
+        return {
+            "resident": store.resident(),
+            "available": store.scan(),
+            "stats": store.stats(),
+        }
 
     def _make_handler(self):
         server = self  # live reference: tests can swap fault_injector mid-run
@@ -348,7 +451,7 @@ class InferenceServer:
                         length = int(self.headers.get("Content-Length", 0))
                         payload = json.loads(self.rfile.read(length) or b"{}")
                         self._reply_json(200, server._handle_admin(path, payload))
-                    except (ValueError, TypeError) as e:
+                    except (ValueError, TypeError, AdapterError) as e:
                         self._reply_json(400, {"error": str(e)})
                     except Exception as e:  # pragma: no cover - defensive
                         self._reply_json(500, {"error": repr(e)})
@@ -423,6 +526,12 @@ class InferenceServer:
 
             def do_GET(self):  # noqa: N802
                 path = self.path.rstrip("/")
+                if path == "/admin/adapters":
+                    try:
+                        self._reply_json(200, server._adapter_snapshot())
+                    except (ValueError, AdapterError) as e:
+                        self._reply_json(400, {"error": str(e)})
+                    return
                 if path == "/metrics":
                     self._reply(
                         200, server.metrics.render().encode(),
@@ -449,6 +558,7 @@ class InferenceServer:
                         server.engine.kv_stats()
                         if hasattr(server.engine, "kv_stats") else {}
                     )
+                    store = server._adapter_store()
                     self._reply_json(200, {
                         # liveness ("process is up") vs readiness ("can
                         # take traffic now") — a reload in flight is live
@@ -467,6 +577,16 @@ class InferenceServer:
                         # paged-pool occupancy (empty dict when paging is
                         # off) — supervisors surface these per-replica
                         **({"kv": kv} if kv else {}),
+                        # resident adapters (multi-tenant only) — fleet
+                        # routers prefer replicas already holding the
+                        # request's adapter (no load on the hot path)
+                        **(
+                            {"adapters": {
+                                "resident": store.resident(),
+                                "capacity": store.capacity,
+                            }}
+                            if store is not None else {}
+                        ),
                     })
                     return
                 self.send_error(404)
@@ -483,7 +603,10 @@ class InferenceServer:
         self.port = self._httpd.server_address[1]  # resolve port 0
         self._shutdown_done = False
         self.scheduler.start()
-        if self.watcher.watch_dir:
+        store = self._adapter_store()
+        if self.watcher.watch_dir or (store is not None and store.adapter_dir):
+            # the poll thread also drives per-adapter hot-reload, so a
+            # multi-tenant server needs it even without a trunk watch_dir
             self.watcher.start()
 
     @property

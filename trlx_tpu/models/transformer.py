@@ -231,13 +231,27 @@ def lora_dense(mod: nn.Module, cfg: TransformerConfig, feats: int, name: str, us
     (`<name>_lora_a/b`), so base weights keep their HF-interop layout and
     the adapter subtree can be masked/saved/zeroed independently —
     functionally what the reference gets from peft wrapping
-    (modeling_base.py:123-326)."""
+    (modeling_base.py:123-326).
+
+    Multi-tenant serving threads *per-row* adapter factors through the
+    `lora_rows` variable collection: when `<name>_lora_a/b` exist there
+    (shapes [b, d, r] / [b, r, feats], one factor pair per batch row),
+    they replace the param-tree adapter entirely — the heterogeneous
+    batch applies each row's own adapter in one program, and a zero
+    factor pair reproduces the base policy exactly (the delta term is a
+    multiply-by-zero, bitwise 0.0 in floating point)."""
     base = nn.Dense(feats, use_bias=use_bias, dtype=cfg.dtype, param_dtype=cfg.param_dtype, name=name)
     if cfg.lora_rank <= 0 or name not in cfg.lora_targets:
         return base
 
     def fwd(x):
         y = base(x)
+        scale = cfg.lora_alpha / cfg.lora_rank
+        if mod.has_variable("lora_rows", f"{name}_lora_a"):
+            ar = mod.get_variable("lora_rows", f"{name}_lora_a")  # [b, d, r]
+            br = mod.get_variable("lora_rows", f"{name}_lora_b")  # [b, r, feats]
+            xr = jnp.einsum("b...d,bdr->b...r", x.astype(cfg.dtype), ar.astype(cfg.dtype))
+            return y + jnp.einsum("b...r,brf->b...f", xr, br.astype(cfg.dtype)) * scale
         a = mod.param(
             f"{name}_lora_a",
             nn.initializers.normal(stddev=1.0 / cfg.lora_rank),
@@ -245,7 +259,6 @@ def lora_dense(mod: nn.Module, cfg: TransformerConfig, feats: int, name: str, us
             cfg.param_dtype,
         )
         b = mod.param(f"{name}_lora_b", nn.initializers.zeros, (cfg.lora_rank, feats), cfg.param_dtype)
-        scale = cfg.lora_alpha / cfg.lora_rank
         return y + (x.astype(cfg.dtype) @ a.astype(cfg.dtype)) @ b.astype(cfg.dtype) * scale
 
     return fwd

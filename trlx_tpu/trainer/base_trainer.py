@@ -452,7 +452,12 @@ class TPUTrainer(BaseRLTrainer):
         `background=True` starts a daemon thread and returns the
         `InferenceServer` (its `.url` is the base endpoint); otherwise
         this blocks serving forever."""
-        from trlx_tpu.inference import InferenceEngine, InferenceServer, Scheduler
+        from trlx_tpu.inference import (
+            AdapterStore,
+            InferenceEngine,
+            InferenceServer,
+            Scheduler,
+        )
         from trlx_tpu.ops.sampling import GenerationConfig
 
         icfg = self.config.inference
@@ -464,6 +469,17 @@ class TPUTrainer(BaseRLTrainer):
         gen_cfg = GenerationConfig.from_gen_kwargs(
             gen_kwargs, self.tokenizer.eos_token_id, self.tokenizer.pad_token_id
         )
+        adapter_store = None
+        if icfg.multi_tenant:
+            # the serving params only donate LoRA leaf paths/shapes to the
+            # store; multi-tenant programs read factors from the stack
+            # (slot 0 = zeros = base policy), never from the param leaves
+            adapter_store = AdapterStore(
+                self.serving_params(),
+                adapter_dir=icfg.adapter_dir,
+                max_resident=icfg.max_resident_adapters,
+                hbm_budget_bytes=int(icfg.adapter_hbm_budget_mb * 1024 * 1024),
+            )
         engine = InferenceEngine(
             self.model, self.model_cfg, self.serving_params(), gen_cfg,
             num_slots=icfg.num_slots,
@@ -477,12 +493,17 @@ class TPUTrainer(BaseRLTrainer):
             kv_cache_dtype=icfg.kv_cache_dtype,
             prefix_cache=icfg.prefix_cache,
             prefix_cache_capacity=icfg.prefix_cache_capacity,
+            multi_tenant=icfg.multi_tenant,
+            adapter_store=adapter_store,
         )
         scheduler = Scheduler(
             engine,
             max_queue_depth=icfg.max_queue_depth,
             max_wait_s=icfg.max_wait_s,
             default_deadline_s=icfg.default_deadline_s,
+            fair_share=icfg.fair_share and icfg.multi_tenant,
+            tenant_weights=icfg.tenant_weights,
+            tenant_queue_depth=icfg.tenant_queue_depth,
         )
         server = InferenceServer(
             scheduler,

@@ -7,6 +7,7 @@ weighted fair-share admission, and the /admin/adapters control plane."""
 import json
 import os
 import threading
+import time
 import urllib.request
 import zlib
 from collections import Counter, deque
@@ -285,6 +286,49 @@ def test_prefix_salt_isolation(trainer, adapter_dir):
     assert engine.kv_stats()["prefix_cache_hits"] == 2
 
 
+def test_base_flush_does_not_sweep_tenant_prefixes(trainer, adapter_dir):
+    """The base policy's salt is empty — flushing it must drop only the
+    unsalted key space, not startswith-match every tenant's salted keys."""
+    adir, _ = adapter_dir
+    store = AdapterStore(trainer.params, adapter_dir=adir, max_resident=4)
+    engine = make_mt_engine(
+        trainer, store, num_slots=2, max_new=4,
+        kv_paging=True, kv_block_size=8, prefix_cache=True,
+        prefix_cache_capacity=16,
+    )
+    p = np.random.RandomState(2).randint(0, 255, size=33).astype(np.int32)
+    run_engine(engine, [(p, 4, None)])  # base: unsalted keys
+    run_engine(engine, [(p, 4, "a1")])  # tenant: salted keys
+    assert engine.flush_adapter_prefixes(None) > 0
+    run_engine(engine, [(p, 4, "a1")])  # a1's blocks survived the base flush
+    assert engine.kv_stats()["prefix_cache_hits"] == 1
+    run_engine(engine, [(p, 4, None)])  # base really is cold again
+    assert engine.kv_stats()["prefix_cache_hits"] == 1
+
+
+def test_lru_evicted_adapter_flushes_stale_prefixes_on_reload(trainer, adapter_dir):
+    """Store-internal LRU eviction remembers the evicted adapter's
+    version; if its checkpoint moves while it is out of the stack, the
+    next load flushes its salted prefixes (cached K/V was computed under
+    the old factors). Unchanged checkpoints re-load without a flush."""
+    adir, variants = adapter_dir
+    store = AdapterStore(trainer.params, adapter_dir=adir, max_resident=1)
+    flushed = []
+    store.flush_prefixes = flushed.append
+    store.load("a1")
+    store.load("a2")  # capacity 1: LRU-evicts a1
+    assert store.resident() == ["a2"]
+    store.load("a1")  # checkpoint unchanged while evicted -> no flush
+    assert flushed == []
+    store.load("a2")  # a1 out again...
+    _save_adapter(_perturb(trainer.params, seed=55),
+                  os.path.join(adir, "a1"), step=20)  # ...and it moves on disk
+    store.load("a1")  # stale re-load must flush a1's salted prefixes
+    assert flushed == ["a1"]
+    # restore the fixture's a1 factors for later tests
+    _save_adapter(variants["a1"], os.path.join(adir, "a1"), step=21)
+
+
 # ---------------------------------------------------------------------------
 # Fair-share admission (weighted deficit round-robin)
 # ---------------------------------------------------------------------------
@@ -373,6 +417,60 @@ def test_per_tenant_queue_depth_cap():
         sched._enqueue([_mk_req("hot", 2)])
     sched._enqueue([_mk_req("cold", 3)])  # other tenants unaffected
     assert len(sched._queue) == 3
+
+
+def test_admission_sheds_over_capacity_adapter_burst(trainer, adapter_dir):
+    """A burst of more distinct tenants than the store has slots into an
+    IDLE pool must not livelock: admission sheds tenant groups until the
+    rest fit (head group always admits), and the shed tenants admit once
+    the first wave's pins drop — every request still completes."""
+    adir, _ = adapter_dir
+    store = AdapterStore(trainer.params, adapter_dir=adir, max_resident=1)
+    engine = make_mt_engine(trainer, store, num_slots=2, max_new=4)
+    sched = Scheduler(engine, max_wait_s=0.0, fair_share=True)
+    reqs = [_mk_req("a1", 0), _mk_req("a2", 1)]
+    sched._queue.extend(reqs)
+
+    sched._admit()  # capacity 1: only one tenant's request can pin
+    assert len(sched._slot_req) == 1, "over-capacity burst must shrink, not requeue"
+    assert len(sched._queue) == 1
+    while sched._slot_req:
+        sched._decode_once()
+    sched._admit()  # the first tenant is idle now -> LRU slot frees
+    assert len(sched._slot_req) == 1 and not sched._queue
+    while sched._slot_req:
+        sched._decode_once()
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert store.stats()["evictions"] >= 1
+
+
+def test_drain_tenant_sees_mid_admission_requests():
+    """A request popped for admission but not yet registered in a slot
+    already holds its adapter pin — drain_tenant must wait for it, or a
+    hot-reload races the pin and silently defers."""
+    sched = _fair_scheduler({})
+    sched._admitting = [_mk_req("a1", 0)]
+    assert sched.drain_tenant("a1", timeout_s=0.05) is False
+    sched.resume_tenant("a1")
+    sched._admitting = []
+    assert sched.drain_tenant("a1", timeout_s=0.05) is True
+    sched.resume_tenant("a1")
+
+
+def test_tiny_weight_tops_up_in_one_step():
+    """Deficit top-up is O(1) per admission round, not O(1/weight): a
+    lone tenant at weight 1e-6 must pop immediately instead of spinning
+    ~1e6 iterations under the scheduler condition lock."""
+    sched = _fair_scheduler({"slow": 1e-6})
+    sched._queue.append(_mk_req("slow", 0))
+    t0 = time.monotonic()
+    with sched._cond:
+        batch, _, _ = sched._pop_weighted(False, 0)
+    assert [sched._tenant(r) for r in batch] == ["slow"]
+    assert time.monotonic() - t0 < 0.5
+
+    with pytest.raises(ValueError, match="must be > 0"):
+        _fair_scheduler({"bad": 0.0})
 
 
 def test_adapter_id_validation(trainer, adapter_dir):

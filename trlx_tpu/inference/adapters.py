@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from trlx_tpu import resilience
+from trlx_tpu.inference.paging import ADAPTER_SALT_PREFIX
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -50,9 +51,11 @@ class AdapterNotFoundError(AdapterError):
 
 
 class AdapterCapacityError(AdapterError):
-    """Every resident slot is pinned by in-flight requests — the caller
-    should retry once some finish (the scheduler requeues on this, the
-    server answers 503 + Retry-After)."""
+    """The request set needs more adapter slots than are free or
+    evictable right now. The scheduler shrinks the admission batch to
+    fewer distinct adapters on this (requeueing the rest), so a burst of
+    more tenants than `capacity` degrades to smaller batches instead of
+    livelocking; the server answers 503 + Retry-After."""
 
 
 def adapter_salt(name: Optional[str]) -> bytes:
@@ -62,7 +65,7 @@ def adapter_salt(name: Optional[str]) -> bytes:
     byte prefix of another and per-adapter flushes match exactly."""
     if name in BASE_NAMES:
         return b""
-    return b"adapter\x00" + str(name).encode("utf-8") + b"\x00"
+    return ADAPTER_SALT_PREFIX + str(name).encode("utf-8") + b"\x00"
 
 
 def load_adapter_leaves(directory: str) -> Dict[Tuple[str, ...], np.ndarray]:
@@ -141,7 +144,16 @@ class AdapterStore:
         self._name_of: Dict[int, str] = {}
         self._refs: Dict[str, int] = {}
         self._lru: "OrderedDict[str, None]" = OrderedDict()  # idle residents, oldest first
-        self._versions: Dict[str, tuple] = {}  # name -> manifest (step, wall_time)
+        # name -> manifest (step, wall_time) of the factors LAST SERVED.
+        # Survives eviction on purpose: when an evicted adapter re-loads
+        # under a moved checkpoint, its salted prefix-cache blocks hold
+        # K/V computed with the old factors and must flush (see
+        # `flush_prefixes` below).
+        self._versions: Dict[str, tuple] = {}
+        # engine-wired callback (name -> None): flush one adapter's
+        # salted prefix blocks. Called on load when the on-disk version
+        # moved since this adapter was last served.
+        self.flush_prefixes = None
         self.loads = 0
         self.evictions = 0
         self.reloads = 0
@@ -320,18 +332,26 @@ class AdapterStore:
                 f"all {self.capacity} adapter slots are pinned by in-flight "
                 f"requests; cannot load '{name}'"
             )
+        prev = self._versions.get(name)
         try:
             self._write_slot(name, slot)
         except Exception:
             self._free_slots.append(slot)
             raise
         self.loads += 1
+        if (
+            prev is not None
+            and prev != self._versions.get(name)
+            and self.flush_prefixes is not None
+        ):
+            # the checkpoint moved while this adapter was out of the
+            # stack: any cached prefix K/V under its salt is stale
+            self.flush_prefixes(name)
         return slot
 
     def _evict_locked(self, name: str) -> int:
         slot = self._slot_of.pop(name)
         self._name_of.pop(slot, None)
-        self._versions.pop(name, None)
         self._lru.pop(name, None)
         self._free_slots.append(slot)
         self.evictions += 1

@@ -175,6 +175,11 @@ class InferenceEngine:
         self.prefix_cache = bool(prefix_cache) and self.kv_paging
         self.multi_tenant = bool(multi_tenant)
         self.adapter_store = adapter_store if self.multi_tenant else None
+        if self.adapter_store is not None:
+            # store-internal LRU eviction can later re-load an adapter
+            # whose checkpoint moved while it was out — the store calls
+            # back so that adapter's salted prefix blocks flush on load
+            self.adapter_store.flush_prefixes = self.flush_adapter_prefixes
         # slot -> adapter name for requests in flight (store ref held)
         self._slot_adapter: Dict[int, Optional[str]] = {}
         if kv_cache_dtype not in _KV_DTYPES:
@@ -541,7 +546,8 @@ class InferenceEngine:
     def _acquire_adapters(self, norm, slot_ids) -> List[int]:
         """Pin every row's adapter (loading on demand) and return their
         stack indices. All-or-nothing: a capacity failure releases the
-        pins already taken so the scheduler can requeue the whole batch."""
+        pins already taken so the scheduler can retry with a smaller
+        batch (it sheds distinct-adapter groups until the rest fit)."""
         aslots: List[int] = []
         acquired: List[Tuple[int, Optional[str]]] = []
         try:

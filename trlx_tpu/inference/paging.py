@@ -32,6 +32,12 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+#: every adapter salt starts with this marker (see
+#: `adapters.adapter_salt`); the base policy keeps the UNSALTED key
+#: space, so an empty salt must never startswith-match salted keys
+ADAPTER_SALT_PREFIX = b"adapter\x00"
+
+
 class KVPoolExhaustedError(RuntimeError):
     """The paged arena has no free or evictable block left. The scheduler
     prevents this by admitting on projected block budgets; direct engine
@@ -204,8 +210,17 @@ class BlockPool:
         """Forget every stored prefix under one salt (per-adapter
         hot-reload: only that adapter's cached K/V went stale). Same
         holder semantics as flush_cached, scoped to keys carrying the
-        salt. Returns the number of keys dropped."""
-        doomed = [key for key in self._store if key.startswith(salt)]
+        salt. The base policy's salt is empty — it owns the unsalted key
+        space, so an empty salt flushes only unsalted keys instead of
+        startswith-matching every tenant's. Returns the number of keys
+        dropped."""
+        if salt:
+            doomed = [key for key in self._store if key.startswith(salt)]
+        else:
+            doomed = [
+                key for key in self._store
+                if not key.startswith(ADAPTER_SALT_PREFIX)
+            ]
         for key in doomed:
             block = self._store.pop(key)
             self._key_of.pop(block, None)

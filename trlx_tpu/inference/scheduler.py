@@ -26,6 +26,7 @@ condition lock and block on each request's completion event.
 """
 
 import itertools
+import math
 import threading
 import time
 from collections import deque
@@ -34,7 +35,7 @@ from typing import Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from trlx_tpu.inference.adapters import AdapterError
+from trlx_tpu.inference.adapters import AdapterCapacityError, AdapterError
 from trlx_tpu.inference.metrics import InferenceMetrics
 from trlx_tpu.inference.paging import KVPoolExhaustedError
 from trlx_tpu.utils import logging
@@ -118,12 +119,21 @@ class Scheduler:
         # priority classes: admission shares are proportional to weight
         # (unlisted tenants get weight 1.0); 0 = no per-tenant depth cap
         self.tenant_weights = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if not float(w) > 0.0:
+                raise ValueError(
+                    f"tenant weight for '{t}' must be > 0, got {w!r}"
+                )
         self.tenant_queue_depth = int(tenant_queue_depth)
         self._deficit: Dict[str, float] = {}  # WDRR state, tenants with demand
         self._blocked_tenants: Set[str] = set()  # per-adapter drain gates
         self._queue: Deque[InferenceRequest] = deque()
         self._cond = threading.Condition()
         self._slot_req: Dict[int, InferenceRequest] = {}
+        # requests popped for admission but not yet registered in
+        # _slot_req (prefill in progress) — drain_tenant must see these,
+        # else a hot-reload can race a mid-admission adapter pin
+        self._admitting: List[InferenceRequest] = []
         self._free: List[int] = list(range(engine.num_slots))
         self._ids = itertools.count()
         self._running = False
@@ -352,11 +362,20 @@ class Scheduler:
         deadline = time.monotonic() + float(timeout_s)
         while time.monotonic() < deadline:
             with self._cond:
-                if not any(self._tenant(r) == tenant for r in self._slot_req.values()):
+                if not self._tenant_in_flight(tenant):
                     return True
             time.sleep(0.005)
         with self._cond:
-            return not any(self._tenant(r) == tenant for r in self._slot_req.values())
+            return not self._tenant_in_flight(tenant)
+
+    def _tenant_in_flight(self, tenant: str) -> bool:
+        """True while any of `tenant`'s requests hold (or are acquiring)
+        an engine slot: decoding in _slot_req OR popped for admission but
+        not yet registered (the prefill window where the adapter pin is
+        already taken). Call with `self._cond` held."""
+        return any(
+            self._tenant(r) == tenant for r in self._slot_req.values()
+        ) or any(self._tenant(r) == tenant for r in self._admitting)
 
     def resume_tenant(self, adapter_id: Optional[str]) -> None:
         with self._cond:
@@ -462,11 +481,24 @@ class Scheduler:
                 break
             affordable = [t for t in tenants if self._deficit.get(t, 0.0) >= 1.0]
             if not affordable:
+                # top every tenant up by as many weight rounds as the
+                # quickest-to-afford tenant needs to reach 1.0 — in ONE
+                # step. A per-round loop is equivalent but would spin
+                # ~1/w times for tiny weights while holding the
+                # condition lock, stalling the driver thread.
+                rounds = max(1, min(
+                    math.ceil(
+                        (1.0 - self._deficit.get(t, 0.0)) / self._weight(t)
+                    )
+                    for t in tenants
+                ))
                 for t in tenants:
-                    self._deficit[t] = self._deficit.get(t, 0.0) + self._weight(t)
+                    self._deficit[t] = (
+                        self._deficit.get(t, 0.0) + rounds * self._weight(t)
+                    )
                 affordable = [t for t in tenants if self._deficit.get(t, 0.0) >= 1.0]
                 if not affordable:
-                    continue  # weights > 0 guarantee progress
+                    continue  # float rounding fell short; top up again
             pick = max(affordable, key=lambda t: self._deficit.get(t, 0.0))
             req = next(r for r in self._queue if self._tenant(r) == pick)
             if paged:
@@ -521,27 +553,74 @@ class Scheduler:
                     slots.append(self._free.pop())
             if not batch:
                 return
+            self._admitting = list(batch)
             self.metrics.set_gauge("queue_depth", len(self._queue))
-        t0 = time.perf_counter()
-        multi_tenant = getattr(self.engine, "multi_tenant", False)
-        rows = (
-            [(r.prompt_ids, r.max_new_tokens, r.adapter_id) for r in batch]
-            if multi_tenant
-            else [(r.prompt_ids, r.max_new_tokens) for r in batch]
-        )
         try:
-            self.engine.insert_requests(rows, slots)
-        except (KVPoolExhaustedError, AdapterError):
-            # projection raced block state (e.g. an idle cached block the
-            # probe counted as shared got evicted mid-placement), or every
-            # adapter slot is pinned by in-flight requests; the engine
-            # rolled the whole call back — requeue in order and retry
-            # once blocks / adapter slots free
+            self._insert_batch(batch, slots)
+        finally:
             with self._cond:
-                self._queue.extendleft(reversed(batch))
-                self._free.extend(slots)
-                self.metrics.set_gauge("queue_depth", len(self._queue))
-            return
+                self._admitting = []
+        self._sync_kv_metrics()
+
+    def _requeue(self, batch: List[InferenceRequest], slots: List[int]) -> None:
+        with self._cond:
+            self._queue.extendleft(reversed(batch))
+            self._free.extend(slots)
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+
+    def _insert_batch(self, batch: List[InferenceRequest], slots: List[int]) -> None:
+        """Prefill an admitted batch into its slots, shrinking the batch
+        under adapter-capacity pressure so admission always progresses."""
+        multi_tenant = getattr(self.engine, "multi_tenant", False)
+        while True:
+            rows = (
+                [(r.prompt_ids, r.max_new_tokens, r.adapter_id) for r in batch]
+                if multi_tenant
+                else [(r.prompt_ids, r.max_new_tokens) for r in batch]
+            )
+            t0 = time.perf_counter()
+            try:
+                self.engine.insert_requests(rows, slots)
+                break
+            except AdapterCapacityError:
+                # the batch needs more distinct adapters pinned at once
+                # than the store holds slots (e.g. a burst of >capacity
+                # tenants into an idle pool, where no in-flight work will
+                # ever free one) — requeueing the identical batch would
+                # retry forever. Shed the last distinct-adapter group and
+                # try again: the head request's group alone always fits
+                # once any in-flight pins drain.
+                tenants: List[str] = []
+                for r in batch:
+                    t = self._tenant(r)
+                    if t not in tenants:
+                        tenants.append(t)
+                if len(tenants) <= 1:
+                    # a single adapter that cannot pin means every store
+                    # slot is held by in-flight work — requeue and retry
+                    # once those requests finish
+                    self._requeue(batch, slots)
+                    return
+                shed = tenants[-1]
+                kept = [
+                    (r, s) for r, s in zip(batch, slots)
+                    if self._tenant(r) != shed
+                ]
+                self._requeue(
+                    [r for r, s in zip(batch, slots) if self._tenant(r) == shed],
+                    [s for r, s in zip(batch, slots) if self._tenant(r) == shed],
+                )
+                batch = [r for r, _ in kept]
+                slots = [s for _, s in kept]
+                with self._cond:
+                    self._admitting = list(batch)
+            except (KVPoolExhaustedError, AdapterError):
+                # projection raced block state (e.g. an idle cached block
+                # the probe counted as shared got evicted mid-placement);
+                # the engine rolled the whole call back — requeue in
+                # order and retry once blocks / adapter slots free
+                self._requeue(batch, slots)
+                return
         self.metrics.observe("prefill_latency_seconds", time.perf_counter() - t0)
         self.metrics.inc("prefill_batches_total")
         with self._cond:
@@ -551,7 +630,6 @@ class Scheduler:
             if len(self._slot_req) > self._slots_active_peak:
                 self._slots_active_peak = len(self._slot_req)
                 self.metrics.set_gauge("slots_active_peak", self._slots_active_peak)
-        self._sync_kv_metrics()
 
     def _decode_once(self) -> None:
         t0 = time.perf_counter()

@@ -1,0 +1,269 @@
+"""CI smoke: end-to-end tracing on a short supervised-fleet PPO run.
+
+A 2-collection CPU run with `train.tracing` + `inference.tracing` on
+(decode-step sampling at rate 1.0) that must produce:
+
+- a parseable Chrome-trace/Perfetto file of trainer phase spans
+  (generate / score / train_minibatch, first-call compile split out);
+- a parseable Perfetto file of cross-process request traces whose
+  server-side stage spans (queue_wait -> admission -> prefill -> decode
+  -> serialize) cover >=95% of each request's served wall time — the
+  per-stage p50s are printed;
+- one injected watchdog hang (the reward_fn wedges mid-collection) that
+  fires the StepWatchdog and yields exactly one complete postmortem
+  bundle: flight-recorder events, thread stacks, the last metrics
+  render, and the run config.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/trace_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from trlx_tpu.data.default_configs import default_ppo_config  # noqa: E402
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline  # noqa: E402
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402
+from trlx_tpu.utils import set_seed  # noqa: E402
+
+MAX_NEW = 4
+HANG_S = 6.0        # how long the reward_fn wedges
+HANG_TIMEOUT_S = 2.0  # watchdog bound applied around the injected hang
+STAGES = ("queue_wait", "admission", "prefill", "decode", "serialize")
+
+
+def build_config(workdir: str):
+    return default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(
+            seq_length=32, batch_size=4, epochs=4, total_steps=4,
+            checkpoint_interval=100, eval_interval=100,
+            tracker="jsonl",
+            logging_dir=os.path.join(workdir, "logs"),
+            checkpoint_dir=os.path.join(workdir, "ckpts"),
+            seed=7,
+            tracing=True,
+            trace_dir=os.path.join(workdir, "traces"),
+            postmortem_dir=os.path.join(workdir, "postmortems"),
+            # generous steady-state bound (the first train step compiles);
+            # the chaos hook tightens it around the injected hang
+            step_timeout_s=600.0,
+            rollout_backend="fleet",
+            rollout_fleet_supervised=True,
+            rollout_fleet_size=2,
+            rollout_fleet_kwargs=dict(replica_retries=1, hedge=False),
+            rollout_fleet_supervisor_kwargs=dict(
+                tick_s=0.02, probe_interval_s=0.1, unhealthy_after=2,
+                respawn_backoff_s=0.2, respawn_backoff_max_s=1.0,
+                sync_interval_s=3600.0, start_timeout_s=300.0,
+            ),
+        ),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=1,
+                    gen_kwargs=dict(max_new_tokens=MAX_NEW, do_sample=False)),
+        inference=dict(num_slots=4, max_prompt_len=32, max_new_tokens=MAX_NEW,
+                       max_wait_s=0.0, tracing=True, trace_sample_rate=1.0),
+    )
+
+
+def walk(span_dicts):
+    for d in span_dicts or ():
+        yield d
+        yield from walk(d.get("children", ()))
+
+
+def server_side_coverage(trace_dict):
+    """Union coverage of the grafted server-side stage spans over the
+    request's served window [first span start, last span end]."""
+    spans = [d for d in walk(trace_dict["spans"])
+             if d["name"] in STAGES and d.get("dur") is not None]
+    if not spans:
+        return 0.0, {}
+    t0 = min(s["ts"] for s in spans)
+    t1 = max(s["ts"] + s["dur"] for s in spans)
+    if t1 <= t0:
+        return 0.0, {}
+    ivals = sorted((s["ts"], s["ts"] + s["dur"]) for s in spans)
+    covered, cursor = 0.0, t0
+    for a, b in ivals:
+        if b <= cursor:
+            continue
+        covered += b - max(a, cursor)
+        cursor = b
+    durs = {}
+    for s in spans:
+        durs.setdefault(s["name"], []).append(s["dur"])
+    return covered / (t1 - t0), durs
+
+
+def load_perfetto(path):
+    with open(path) as f:
+        obj = json.load(f)
+    events = obj["traceEvents"]
+    assert events, f"{path}: empty traceEvents"
+    assert all(e["ph"] in ("X", "M") for e in events), "unknown phase type"
+    xs = [e for e in events if e["ph"] == "X"]
+    assert all(
+        isinstance(e["ts"], (int, float)) and e["dur"] >= 0 for e in xs
+    ), f"{path}: bad ts/dur"
+    return events
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="trace_smoke_")
+    config = build_config(workdir)
+    set_seed(config.train.seed)
+
+    state = {"hung": False, "fired_at": None}
+    trainer = None
+
+    def reward_fn(samples, **kw):
+        # chaos hook: once the run is warm (second collection — the first
+        # optimizer steps are done and _last_stats is populated), wedge
+        # this reward_fn past the watchdog bound. The bound is tightened
+        # here so CI doesn't wait minutes for a "real" timeout; the hang
+        # itself is the documented infinite-reward_fn scenario.
+        if trainer is not None and not state["hung"] and trainer.iter_count >= 2:
+            state["hung"] = True
+            dog = trainer._watchdog
+            assert dog is not None, "train.step_timeout_s did not arm a watchdog"
+            dog.timeout_s = HANG_TIMEOUT_S
+            print(f"[chaos] wedging reward_fn for {HANG_S:.0f}s "
+                  f"(watchdog bound {HANG_TIMEOUT_S:.0f}s)")
+            time.sleep(HANG_S)
+        return [float(len(s)) for s in samples]
+
+    trainer = PPOTrainer(config, reward_fn=reward_fn)
+    # survive the fire: the default on_timeout is os._exit(75) (auto
+    # resume); the smoke records the fire and lets the run finish so the
+    # bundle can be inspected in-process
+    trainer._watchdog_on_timeout = lambda: state.update(
+        fired_at=time.monotonic()
+    )
+    prompts = ["hello world", "jax tpu", "ppo", "trace"] * 2
+    max_prompt_length = config.train.seq_length - MAX_NEW
+    trainer.add_prompt_pipeline(
+        PromptPipeline(prompts, max_prompt_length, trainer.tokenizer)
+    )
+    trainer.add_eval_pipeline(
+        PromptPipeline(prompts, max_prompt_length, trainer.tokenizer)
+    )
+    tracer = None
+
+    orig_shutdown = trainer.shutdown_rollout_fleet
+
+    def shutdown_and_keep_tracer():
+        nonlocal tracer
+        if trainer._rollout_tracer is not None:
+            tracer = trainer._rollout_tracer
+        orig_shutdown()
+
+    trainer.shutdown_rollout_fleet = shutdown_and_keep_tracer
+    trainer.learn()
+
+    assert trainer.iter_count == config.train.total_steps, (
+        f"run stopped at step {trainer.iter_count}/{config.train.total_steps}"
+    )
+    assert state["hung"], "chaos hook never ran (no second collection?)"
+    assert state["fired_at"] is not None, "watchdog did not fire on the hang"
+
+    # --- trainer phase timeline ---------------------------------------
+    timeline_path = os.path.join(config.train.trace_dir, "train_timeline.json")
+    events = load_perfetto(timeline_path)
+    phase_names = {e["name"] for e in events if e["ph"] == "X"}
+    for want in ("make_experience", "rollout_generate", "rollout_score",
+                 "train_minibatch"):
+        assert want in phase_names, f"missing phase span {want}: {phase_names}"
+    firsts = [e["name"] for e in events
+              if e["ph"] == "X" and e.get("args", {}).get("first_call")]
+    assert "train_minibatch" in firsts, "first-call (compile) split missing"
+
+    rows = []
+    for name in os.listdir(config.train.logging_dir):
+        if name.endswith(".metrics.jsonl"):
+            with open(os.path.join(config.train.logging_dir, name)) as f:
+                rows += [json.loads(line) for line in f if line.strip()]
+    assert any("timing/train_minibatch_first_ms" in r for r in rows), (
+        "timing/*_first_ms never exported through the tracker"
+    )
+    assert any("timing/train_minibatch_ms" in r for r in rows), (
+        "steady-state timing/*_ms never exported through the tracker"
+    )
+    final_loss = [r for r in rows if "losses/total_loss" in r][-1]["losses/total_loss"]
+    assert np.isfinite(final_loss), f"non-finite final loss {final_loss}"
+
+    # --- cross-process request traces ---------------------------------
+    req_trace_path = os.path.join(config.train.trace_dir, "rollout_requests.json")
+    load_perfetto(req_trace_path)
+    assert tracer is not None, "router tracer was never created"
+    traces = tracer.recent(1000)
+    served = [t for t in traces if any(
+        d["name"] == "attempt" and d["status"] == "ok"
+        for d in walk(t["spans"])
+    )]
+    assert len(served) >= config.method.num_rollouts, (
+        f"only {len(served)} served request traces captured"
+    )
+    coverages, stage_durs = [], {}
+    for td in served:
+        cov, durs = server_side_coverage(td)
+        coverages.append(cov)
+        for k, v in durs.items():
+            stage_durs.setdefault(k, []).extend(v)
+    worst = min(coverages)
+    assert worst >= 0.95, (
+        f"server-side stage spans cover only {worst:.1%} of the worst "
+        "request's wall time (want >=95%)"
+    )
+    for stage in STAGES:
+        assert stage in stage_durs, f"no {stage} span in any request trace"
+    p50s = ", ".join(
+        f"{stage} p50 {1e3 * float(np.percentile(stage_durs[stage], 50)):.2f}ms"
+        for stage in STAGES
+    )
+
+    # --- postmortem bundle --------------------------------------------
+    pm_root = config.train.postmortem_dir
+    bundles = sorted(os.listdir(pm_root)) if os.path.isdir(pm_root) else []
+    assert len(bundles) == 1, (
+        f"expected exactly one postmortem bundle, found {bundles}"
+    )
+    bundle = os.path.join(pm_root, bundles[0])
+    with open(os.path.join(bundle, "trigger.json")) as f:
+        trig = json.load(f)
+    assert trig["trigger"] == "step-watchdog", trig
+    assert trig["detail"]["step"] == 2
+    with open(os.path.join(bundle, "events.jsonl")) as f:
+        fr_events = [json.loads(line) for line in f]
+    assert fr_events, "no flight-recorder events in the bundle"
+    components = {e["component"] for e in fr_events}
+    assert "scheduler" in components, f"no scheduler events: {components}"
+    with open(os.path.join(bundle, "threads.txt")) as f:
+        threads = f.read()
+    assert "MainThread" in threads and "trlx-tpu" in threads, (
+        "thread stacks incomplete"
+    )
+    with open(os.path.join(bundle, "metrics.prom")) as f:
+        metrics = f.read()
+    assert "losses/total_loss" in metrics, "last metrics render missing"
+    with open(os.path.join(bundle, "config.json")) as f:
+        assert json.load(f)["train"]["tracing"] is True
+
+    print(
+        f"trace smoke OK: {config.train.total_steps} steps, "
+        f"{len(served)} request traces (worst stage coverage {worst:.1%}), "
+        f"{p50s}; watchdog fired once -> bundle {os.path.basename(bundle)} "
+        f"({len(fr_events)} flight-recorder events)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,413 @@
+"""Observability tests (trlx_tpu/observability/* + serving/trainer wiring).
+
+Covers ISSUE 13's acceptance pins:
+
+- hedged-request span tree: winner ok + loser cancelled/wasted, no span
+  leaks anywhere in the tree;
+- trace propagation across a failover re-dispatch (the second replica
+  serves under the SAME trace_id and its server-side spans graft in);
+- flight-recorder ring stays bounded under churn;
+- postmortem bundles are written exactly once per trigger and contain
+  events + thread stacks + metrics + config;
+- the flag-off pin: tracing on vs off produces bitwise identical
+  engine/scheduler outputs;
+- request_id / death-stage satellites on the HTTP error surface;
+- Chrome-trace export structure and the JSON log format.
+"""
+
+import json
+import logging as std_logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trlx_tpu import resilience
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.inference import ReplicaRouter, remote_generate
+from trlx_tpu.observability import (
+    FlightRecorder,
+    PhaseTimeline,
+    RequestTrace,
+    Span,
+    Tracer,
+    postmortem,
+    snapshot_all,
+    to_chrome_trace,
+)
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+from trlx_tpu.utils import logging as trlx_logging
+
+MAX_NEW = 4
+SUPPRESS = [i for i in range(259) if not (32 <= i < 127 or i == 258)]
+GEN = dict(max_new_tokens=MAX_NEW, do_sample=False, suppress_tokens=SUPPRESS)
+PROMPTS = ["hello world", "jax tpu", "ppo", "trace"] * 2
+ID_PROMPTS = [[72, 101, 108, 108], [106, 97, 120], [112, 112, 111], [102, 108]]
+
+REWARD_FN = lambda samples, **kw: [float(len(s)) for s in samples]  # noqa: E731
+
+
+def _config(tmp_path, tracing=True, **inference_over):
+    return default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, total_steps=4, tracker=None,
+                   checkpoint_dir=str(tmp_path), seed=11),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(GEN)),
+        inference=dict(num_slots=4, max_prompt_len=32, max_new_tokens=MAX_NEW,
+                       max_wait_s=0.0, tracing=tracing, **inference_over),
+    )
+
+
+@pytest.fixture(scope="module")
+def obs_trainer(tmp_path_factory):
+    trainer = PPOTrainer(_config(tmp_path_factory.mktemp("obs_srv")),
+                         reward_fn=REWARD_FN)
+    pipeline = PromptPipeline(PROMPTS, max_prompt_length=8,
+                              tokenizer=trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    return trainer
+
+
+@pytest.fixture(scope="module")
+def traced_pair(obs_trainer):
+    """Two warm replicas serving with inference.tracing on."""
+    servers = [
+        obs_trainer.serve(host="127.0.0.1", port=0, background=True)
+        for _ in range(2)
+    ]
+    for s in servers:
+        assert s.tracer is not None, "inference.tracing=True must wire a tracer"
+        remote_generate(s.url)(ID_PROMPTS[0], max_new_tokens=MAX_NEW)
+    yield servers
+    for s in servers:
+        s.shutdown()
+
+
+def _post(url, payload, headers=None, timeout=30.0):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _walk(span_dicts):
+    for d in span_dicts:
+        yield d
+        yield from _walk(d.get("children", ()))
+
+
+# ----------------------------------------------------------------------
+# Core span/trace unit behavior
+# ----------------------------------------------------------------------
+
+
+def test_span_dict_roundtrip_and_leak_detector():
+    trace = RequestTrace()
+    outer = trace.span("outer", a=1)
+    inner = outer.child("inner")
+    assert trace.open_spans() == 2
+    inner.end()
+    outer.end(status="error")
+    trace.finish()
+    assert trace.open_spans() == 0
+
+    rt2 = RequestTrace()
+    rt2.adopt([s.to_dict() for s in trace.spans])
+    sp = rt2.spans[0]
+    assert sp.name == "outer" and sp.status == "error" and sp.attrs == {"a": 1}
+    # monotonic times survive the epoch round trip in-process
+    assert sp.t0 == pytest.approx(outer.t0, abs=1e-6)
+    assert sp.children[0].name == "inner"
+
+
+def test_trace_coverage_unions_overlaps():
+    trace = RequestTrace()
+    t0 = trace.t_start
+    trace.add("a", t0, t0 + 0.5)
+    trace.add("b", t0 + 0.25, t0 + 0.75)  # overlaps a: union is [0, 0.75]
+    trace.finish(t0 + 1.0)
+    assert trace.coverage() == pytest.approx(0.75)
+
+
+def test_decode_step_sampler_is_deterministic():
+    tracer = Tracer(sample_rate=0.25)
+    hits = [tracer.sample_decode_step() for _ in range(16)]
+    assert sum(hits) == 4
+    assert hits == [False, False, False, True] * 4
+    assert not any(Tracer(sample_rate=0.0).sample_decode_step()
+                   for _ in range(32))
+
+
+def test_chrome_trace_export_structure(tmp_path):
+    tracer = Tracer()
+    trace = tracer.new_trace(request_id="req1")
+    trace.add("queue_wait", trace.t_start, trace.t_start + 0.01)
+    sp = trace.add("prefill", trace.t_start + 0.01, trace.t_start + 0.02)
+    sp.children.append(Span("block_alloc", t0=sp.t0).end(sp.t0 + 0.001))
+    tracer.finish(trace)
+    tracer.add_aggregate(Span("decode_step").end())
+
+    path = tracer.write_chrome_trace(str(tmp_path / "t.json"))
+    with open(path) as f:
+        obj = json.load(f)  # must be plain parseable JSON for Perfetto
+    events = obj["traceEvents"]
+    names = {e["name"] for e in events}
+    assert {"queue_wait", "prefill", "block_alloc", "decode_step"} <= names
+    xs = [e for e in events if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 and e["ts"] > 0 for e in xs)
+    lanes = {e["tid"]: e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert "req req1" in lanes.values()
+    assert "engine (sampled decode steps)" in lanes.values()
+
+
+def test_phase_timeline_first_vs_steady_split():
+    tl = PhaseTimeline()
+    with tl.phase("train_minibatch", step=0):
+        pass
+    stats = tl.drain_stats()
+    assert "timing/train_minibatch_first_ms" in stats
+    assert "timing/train_minibatch_ms" not in stats  # no steady samples yet
+    tl.add("train_minibatch", 0.0, 0.010)
+    tl.add("train_minibatch", 0.0, 0.020)
+    stats = tl.drain_stats()
+    assert stats["timing/train_minibatch_ms"] == pytest.approx(15.0)
+    assert "timing/train_minibatch_first_ms" not in stats  # emitted once
+    spans = tl.to_chrome_trace()["traceEvents"]
+    firsts = [e for e in spans if e.get("args", {}).get("first_call")]
+    assert len(firsts) == 1
+
+
+# ----------------------------------------------------------------------
+# Flight recorder + postmortem
+# ----------------------------------------------------------------------
+
+
+def test_flight_recorder_ring_bound_under_churn():
+    rec = FlightRecorder("test-churn", capacity=64)
+    for i in range(10_000):
+        rec.record("tick", i=i)
+    assert len(rec) == 64
+    assert rec.dropped == 10_000 - 64
+    events = rec.snapshot()
+    assert events[-1]["i"] == 9_999 and events[0]["i"] == 9_999 - 63
+    assert all(e["component"] == "test-churn" for e in events)
+    merged = snapshot_all()
+    assert [e for e in merged if e.get("component") == "test-churn"]
+
+
+def test_postmortem_written_exactly_once_per_trigger(tmp_path):
+    postmortem.reset_triggers()
+    rec = FlightRecorder("test-pm", capacity=8)
+    rec.record("boom", detail="x")
+    kwargs = dict(
+        trigger="step-watchdog",
+        out_dir=str(tmp_path / "pm"),
+        detail={"step": 3},
+        recorders=[rec],
+        metrics_render="loss 1.0",
+        config={"train": {"seed": 11}},
+    )
+    path = postmortem.maybe_dump("watchdog-step3", **kwargs)
+    assert path is not None
+    assert postmortem.maybe_dump("watchdog-step3", **kwargs) is None
+    # a different trigger key still fires
+    assert postmortem.maybe_dump("watchdog-step4", **kwargs) is not None
+
+    with open(f"{path}/trigger.json") as f:
+        trig = json.load(f)
+    assert trig["trigger"] == "step-watchdog" and trig["detail"]["step"] == 3
+    with open(f"{path}/events.jsonl") as f:
+        events = [json.loads(line) for line in f]
+    assert any(e["kind"] == "boom" for e in events)
+    with open(f"{path}/threads.txt") as f:
+        assert "MainThread" in f.read()
+    with open(f"{path}/metrics.prom") as f:
+        assert f.read() == "loss 1.0"
+    with open(f"{path}/config.json") as f:
+        assert json.load(f)["train"]["seed"] == 11
+    postmortem.reset_triggers()
+
+
+# ----------------------------------------------------------------------
+# JSON log format satellite
+# ----------------------------------------------------------------------
+
+
+def test_json_log_formatter_emits_trace_context():
+    fmt = trlx_logging.JSONLogFormatter()
+    record = std_logging.LogRecord(
+        "trlx_tpu.test", std_logging.INFO, __file__, 1, "hello %s", ("x",), None
+    )
+    line = json.loads(fmt.format(record))
+    assert line["msg"] == "hello x" and line["level"] == "INFO"
+    assert line["logger"] == "trlx_tpu.test" and "ts" in line
+    assert "trace_id" not in line and "request_id" not in line
+
+    token = trlx_logging.set_trace_context(trace_id="t1", request_id="r1")
+    try:
+        line = json.loads(fmt.format(record))
+        assert line["trace_id"] == "t1" and line["request_id"] == "r1"
+    finally:
+        trlx_logging.reset_trace_context(token)
+    assert "trace_id" not in json.loads(fmt.format(record))
+
+
+# ----------------------------------------------------------------------
+# Server: ingress ids, span coverage, /debug/trace, error-body satellites
+# ----------------------------------------------------------------------
+
+
+def test_traced_request_reply_spans_and_debug_endpoint(traced_pair):
+    server = traced_pair[0]
+    status, out = _post(server.url, {
+        "prompt_ids": ID_PROMPTS[1], "max_new_tokens": MAX_NEW,
+    }, headers={"X-Request-Id": "req-abc", "X-Trace-Id": "trace-abc"})
+    assert status == 200
+    assert out["request_id"] == "req-abc"
+    assert out["trace_id"] == "trace-abc"  # caller-supplied id propagates
+    names = [d["name"] for d in _walk(out["trace"])]
+    for expected in ("queue_wait", "admission", "prefill", "decode", "serialize"):
+        assert expected in names, f"missing span {expected} in {names}"
+    assert all(d.get("dur") is not None for d in _walk(out["trace"]))
+
+    # /debug/trace serves the ring, newest last
+    with urllib.request.urlopen(server.url + "/debug/trace?last=4") as resp:
+        traces = json.loads(resp.read())["traces"]
+    assert traces and traces[-1]["request_id"] == "req-abc"
+    # the >=95% acceptance metric, on the server-side view of the request
+    td = traces[-1]
+    tr = RequestTrace()
+    tr.adopt(td["spans"])
+    tr.t_start, tr.t_end = tr.spans[0].t0, max(s.t1 for s in tr.spans)
+    assert tr.coverage() >= 0.95
+
+
+def test_error_bodies_carry_request_id_and_death_stage(traced_pair):
+    server = traced_pair[0]
+    # 400: unsupported key
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _post(server.url, {"prompt_ids": ID_PROMPTS[0], "bogus_knob": 1},
+              headers={"X-Request-Id": "req-400"})
+    err = exc_info.value
+    assert err.code == 400
+    assert json.loads(err.read())["request_id"] == "req-400"
+
+    # 504: an already-expired deadline dies in a known stage
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        _post(server.url, {
+            "prompt_ids": ID_PROMPTS[0], "max_new_tokens": MAX_NEW,
+            "deadline_s": 1e-6,
+        }, headers={"X-Request-Id": "req-504"})
+    err = exc_info.value
+    assert err.code == 504
+    body = json.loads(err.read())
+    assert body["request_id"] == "req-504"
+    assert body["finish_reason"] == "deadline"
+    assert body["stage"] in ("queued", "admitted", "prefill", "decode")
+
+
+# ----------------------------------------------------------------------
+# Router: hedged span tree, failover trace propagation
+# ----------------------------------------------------------------------
+
+
+def _router(servers, **kw):
+    kw.setdefault("replica_retries", 0)
+    kw.setdefault("retry_base_delay", 0.05)
+    kw.setdefault("breaker_threshold", 4)
+    kw.setdefault("breaker_recovery", 0.5)
+    kw.setdefault("hedge", False)
+    kw.setdefault("probe_timeout_s", 2.0)
+    kw.setdefault("tracer", Tracer())
+    return ReplicaRouter([s.url for s in servers], **kw)
+
+
+def test_hedged_request_span_tree_no_leaks(traced_pair):
+    router = _router(traced_pair, hedge=True, hedge_after_s=0.2)
+    traced_pair[0].fault_injector = resilience.FaultInjector(
+        rate=1.0, mode="slow", slow_s=2.5
+    )
+    try:
+        res = router.generate_one(ID_PROMPTS[0], max_new_tokens=MAX_NEW)
+        assert res["finish_reason"] in ("eos", "length")
+        trace = router.tracer._completed[-1]
+        assert trace.open_spans() == 0, "span leak in the dispatch tree"
+        td = trace.to_dict()
+        (dispatch,) = td["spans"]
+        assert dispatch["name"] == "dispatch"
+        attempts = [c for c in dispatch["children"] if c["name"] == "attempt"]
+        assert len(attempts) == 2
+        by_status = {a["status"]: a for a in attempts}
+        assert "ok" in by_status
+        assert {"cancelled", "wasted"} & set(by_status), by_status.keys()
+        assert by_status["ok"]["attrs"]["replica"] == traced_pair[1].url
+        # the winner's server-side spans are grafted under its attempt
+        grafted = [d["name"] for d in _walk(by_status["ok"].get("children", ()))]
+        assert "prefill" in grafted and "decode" in grafted
+        # traces carry the replica-assigned request id for log correlation
+        assert trace.request_id == res["request_id"]
+    finally:
+        traced_pair[0].fault_injector = None
+        router.close()
+
+
+def test_failover_redispatch_preserves_trace_id(traced_pair):
+    router = _router(traced_pair)
+    traced_pair[0].fault_injector = resilience.FaultInjector(
+        rate=1.0, mode="http_500"
+    )
+    try:
+        res = router.generate_one(ID_PROMPTS[2], max_new_tokens=MAX_NEW)
+        assert res["finish_reason"] in ("eos", "length")
+        trace = router.tracer._completed[-1]
+        assert trace.open_spans() == 0
+        td = trace.to_dict()
+        (dispatch,) = td["spans"]
+        attempts = [c for c in dispatch["children"] if c["name"] == "attempt"]
+        statuses = [a["status"] for a in attempts]
+        assert "error" in statuses and "ok" in statuses
+        ok = next(a for a in attempts if a["status"] == "ok")
+        assert ok["attrs"]["replica"] == traced_pair[1].url
+        # the winning replica served under the router's trace_id: its
+        # server-side ring shows the same id on the grafted request
+        assert any(
+            t["trace_id"] == td["trace_id"]
+            for t in traced_pair[1].tracer.recent(8)
+        ), "replica did not adopt the router's trace_id"
+    finally:
+        traced_pair[0].fault_injector = None
+        router.close()
+
+
+# ----------------------------------------------------------------------
+# Flag-off pin: tracing must not change engine/scheduler outputs
+# ----------------------------------------------------------------------
+
+
+def test_tracing_off_vs_on_bitwise_identical(obs_trainer):
+    """The acceptance pin: the same greedy requests produce the exact
+    same token ids with tracing off and on (span bookkeeping never
+    touches the compute path)."""
+    icfg = obs_trainer.config.inference
+    outputs = {}
+    for tracing in (False, True):
+        icfg.tracing = tracing
+        icfg.trace_sample_rate = 1.0 if tracing else 0.0
+        server = obs_trainer.serve(host="127.0.0.1", port=0, background=True)
+        try:
+            assert (server.tracer is not None) is tracing
+            gen = remote_generate(server.url)
+            outputs[tracing] = [
+                gen(p, max_new_tokens=MAX_NEW)["token_ids"] for p in ID_PROMPTS
+            ]
+        finally:
+            server.shutdown()
+    icfg.tracing = True
+    icfg.trace_sample_rate = 0.0
+    assert outputs[False] == outputs[True]

@@ -238,6 +238,20 @@ class InferenceConfig:
     :param tenant_queue_depth: per-tenant queued-request cap, rejected
         with HTTP 503 + Retry-After beyond it; 0 = only the global
         max_queue_depth applies.
+    :param tracing: request tracing (trlx_tpu/observability/): per-request
+        span trees (queue wait, admission, adapter loads, block
+        allocation, prefill, decode, serialization), the
+        ``/debug/trace?last=N`` endpoint, and per-component flight
+        recorders. Off (default) keeps the serving hot paths bitwise
+        identical and allocation-free.
+    :param trace_sample_rate: fraction of decode steps recorded as
+        individual batch-level spans (deterministic counter-based
+        sampling; per-request decode spans always aggregate). 0 disables
+        per-step spans so tracing stays cheap enough for load tests.
+    :param trace_ring: completed request traces retained in memory (the
+        ``/debug/trace`` window).
+    :param flight_recorder_events: per-component flight-recorder ring
+        capacity (events retained for postmortem bundles).
     """
 
     num_slots: int = 8
@@ -266,6 +280,10 @@ class InferenceConfig:
     fair_share: bool = True
     tenant_weights: Dict[str, float] = field(default_factory=dict)
     tenant_queue_depth: int = 0
+    tracing: bool = False
+    trace_sample_rate: float = 0.0
+    trace_ring: int = 256
+    flight_recorder_events: int = 512
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):
@@ -401,6 +419,22 @@ class TrainConfig:
     # code 75 (EX_TEMPFAIL) so auto_resume restarts the run. None
     # disables. Active only inside learn().
     step_timeout_s: Optional[float] = None
+
+    # --- Observability (trlx_tpu/observability/) ----------------------
+    # Training timeline tracing: phase spans around generate / score /
+    # make_experience / train_minibatch (first jit-compile call split
+    # from steady state), exported as timing/* stats through the tracker
+    # and as a Chrome-trace/Perfetto JSON at the end of learn(). Also
+    # arms the postmortem bundler: a StepWatchdog fire, a sentinel
+    # rewind/abort, or a supervisor seat quarantine dumps the flight
+    # recorders + thread stacks + last stats + config into
+    # `postmortem_dir`. Off (default) keeps the trainer bit-identical
+    # and allocation-free.
+    tracing: bool = False
+    # Where the training-timeline Chrome trace is written; None derives
+    # logs/traces (under logging_dir when set).
+    trace_dir: Optional[str] = None
+    postmortem_dir: str = "logs/postmortems"
 
     # Generation shape buckets: round generate batches up to multiples of
     # 8 rows / 32 prompt columns (masked padding, outputs trimmed back)

@@ -34,6 +34,7 @@ lock read at each dispatch.
 """
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -219,6 +220,13 @@ class InferenceEngine:
             self._kv_lock = threading.Lock()
         else:
             self._block_pool = None
+
+        # scheduler-owned trace buffer: while a traced batch inserts, the
+        # scheduler sets this to a list and the insert path appends
+        # (name, t0, t1, attrs) tuples (adapter loads, block allocation,
+        # per-bucket prefill dispatches). None = tracing off: the guards
+        # below keep the hot path allocation-free.
+        self.trace_buf: Optional[List] = None
 
         self._params = params
         self._param_lock = threading.Lock()
@@ -552,7 +560,15 @@ class InferenceEngine:
         acquired: List[Tuple[int, Optional[str]]] = []
         try:
             for (ids, max_new, name), slot in zip(norm, slot_ids):
-                aslots.append(self.adapter_store.acquire(name))
+                if self.trace_buf is not None:
+                    t0 = time.monotonic()
+                    aslots.append(self.adapter_store.acquire(name))
+                    self.trace_buf.append((
+                        "adapter_load", t0, time.monotonic(),
+                        {"adapter": name or "base"},
+                    ))
+                else:
+                    aslots.append(self.adapter_store.acquire(name))
                 acquired.append((int(slot), name))
         except Exception:
             for _, name in acquired:
@@ -600,6 +616,7 @@ class InferenceEngine:
                 ids_arr[len(chunk) :] = ids_arr[0]
                 mask_arr[len(chunk) :] = mask_arr[0]
 
+                t0 = time.monotonic() if self.trace_buf is not None else 0.0
                 if mt:
                     aidx = jnp.asarray(aidx_arr)
                     last_logits, cache = self._get_prefill(pb, plen)(
@@ -618,6 +635,11 @@ class InferenceEngine:
                         self._pool, cache, last_logits,
                         jnp.asarray(slots_arr), jnp.asarray(max_new_arr),
                     )
+                if self.trace_buf is not None:
+                    self.trace_buf.append((
+                        "prefill_bucket", t0, time.monotonic(),
+                        {"bucket": plen, "rows": len(chunk)},
+                    ))
 
     def _check_row(self, ids, max_new: int) -> np.ndarray:
         ids = np.asarray(ids, np.int32).reshape(-1)
@@ -662,6 +684,7 @@ class InferenceEngine:
         # requeue the batch and retry once blocks free
         rounds: List[List] = []
         journal: List[Tuple[int, List[int], List[bytes]]] = []
+        t_alloc0 = time.monotonic() if self.trace_buf is not None else 0.0
         with self._kv_lock:
             try:
                 while pending:
@@ -711,6 +734,11 @@ class InferenceEngine:
                     pool.release(blocks)
                     self._slot_blocks.pop(slot, None)
                 raise
+        if self.trace_buf is not None:
+            self.trace_buf.append((
+                "block_alloc", t_alloc0, time.monotonic(),
+                {"rounds": len(rounds), "requests": len(slot_ids)},
+            ))
         # dispatch order between rounds is what makes same-call sharing
         # sound: a round-2 suffix prefill gathers blocks the round-1
         # program has already written by the time it runs
@@ -758,7 +786,13 @@ class InferenceEngine:
                 ]
                 if mt:
                     args += [stack, jnp.asarray(aidx_arr)]
+                t0 = time.monotonic() if self.trace_buf is not None else 0.0
                 self._pool = self._get_paged_insert(pb, plen)(*args)
+                if self.trace_buf is not None:
+                    self.trace_buf.append((
+                        "prefill_bucket", t0, time.monotonic(),
+                        {"bucket": plen, "rows": len(chunk)},
+                    ))
 
     # ------------------------------------------------------------------
     # Decode

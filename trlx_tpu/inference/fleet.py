@@ -169,7 +169,13 @@ class ReplicaRouter:
         hedge_min_samples: int = 16,
         hedge_max_delay_s: float = 5.0,
         _sleep=None,
+        tracer=None,
     ):
+        # cross-process tracing (None = off): every dispatch opens a
+        # parent span, each replica attempt / hedge / failover is a child
+        # span, and the winner's replica-returned span tree is grafted
+        # under its attempt — one timeline per request across processes
+        self.tracer = tracer
         # an empty fleet is allowed (a supervisor registers members as
         # they come up); dispatch against it degrades via
         # FleetUnavailableError like a whole-fleet outage
@@ -361,6 +367,17 @@ class ReplicaRouter:
         with self._lock:
             self.counters["requests"] += 1
 
+        # explicit trace context: local variables only — attempts run on
+        # pool threads, so nothing ambient would survive the hop anyway
+        trace = dispatch = None
+        attempt_spans: Dict[futures.Future, Any] = {}
+        if self.tracer is not None:
+            trace = self.tracer.new_trace(trace_id=payload.get("trace_id"))
+            # the replica opens its server-side trace under the same id
+            # and returns its spans in the reply for grafting
+            payload["trace_id"] = trace.trace_id
+            dispatch = trace.span("dispatch")
+
         tried: List[Replica] = []
         reprobed = False
         last_exc: Optional[BaseException] = None
@@ -373,14 +390,18 @@ class ReplicaRouter:
                 if self.probe_all(force=True):
                     rep = self._pick(exclude=tried, adapter_id=adapter_id)
             if rep is None:
+                if dispatch is not None:
+                    dispatch.end(status="error")
+                    self.tracer.finish(trace)
                 raise FleetUnavailableError(
                     f"no eligible replica (tried {[r.url for r in tried] or 'none'};"
                     f" last error: {last_exc})"
                 )
 
-            pending: Dict[futures.Future, Replica] = {
-                self._requests.submit(self._post, rep, payload): rep
-            }
+            fut0 = self._requests.submit(self._post, rep, payload)
+            pending: Dict[futures.Future, Replica] = {fut0: rep}
+            if dispatch is not None:
+                attempt_spans[fut0] = dispatch.child("attempt", replica=rep.url)
             tried.append(rep)
 
             delay = self._hedge_delay()
@@ -391,7 +412,12 @@ class ReplicaRouter:
                 if not done:
                     hedge_rep = self._pick(exclude=tried, adapter_id=adapter_id)
                     if hedge_rep is not None:
-                        pending[self._requests.submit(self._post, hedge_rep, payload)] = hedge_rep
+                        hfut = self._requests.submit(self._post, hedge_rep, payload)
+                        pending[hfut] = hedge_rep
+                        if dispatch is not None:
+                            attempt_spans[hfut] = dispatch.child(
+                                "attempt", replica=hedge_rep.url, hedge=True
+                            )
                         tried.append(hedge_rep)
                         with self._lock:
                             self.counters["hedges"] += 1
@@ -402,12 +428,17 @@ class ReplicaRouter:
                     outstanding, return_when=futures.FIRST_COMPLETED
                 )
                 winner = None
+                winner_fut = None
                 for fut in done:
                     rep_f = pending[fut]
                     try:
                         out = fut.result()
                     except (resilience.TransientError, resilience.CircuitOpenError) as e:
                         last_exc = e
+                        sp = attempt_spans.get(fut)
+                        if sp is not None:
+                            sp.attrs["error"] = str(e)
+                            sp.end(status="error")
                         with self._lock:
                             self.counters["failovers"] += 1
                         continue
@@ -420,22 +451,44 @@ class ReplicaRouter:
                             f"{out.get('checkpoint_step')} vs trainer step "
                             f"{self.trainer_step})"
                         )
+                        sp = attempt_spans.get(fut)
+                        if sp is not None:
+                            sp.end(status="stale_rejected")
                         with self._lock:
                             self.counters["stale_rejected"] += 1
                         self.probe(rep_f)  # refresh its step so _pick skips it
                         continue
                     winner = out
+                    winner_fut = fut
                     break
                 if winner is not None:
                     for fut in outstanding:  # the hedging loser
                         if fut.cancel():
+                            sp = attempt_spans.get(fut)
+                            if sp is not None:
+                                sp.end(status="cancelled")
                             with self._lock:
                                 self.counters["hedges_cancelled"] += 1
                         else:
                             # in-flight HTTP cannot be aborted: the reply
                             # is discarded when it lands
+                            sp = attempt_spans.get(fut)
+                            if sp is not None:
+                                sp.end(status="wasted")
                             with self._lock:
                                 self.counters["hedges_wasted"] += 1
+                    if dispatch is not None:
+                        wsp = attempt_spans.get(winner_fut)
+                        if wsp is not None:
+                            wsp.end(status="ok")
+                            # graft the replica's server-side span tree
+                            # under the winning attempt — one
+                            # cross-process timeline for this request
+                            trace.adopt(winner.get("trace") or (), parent=wsp)
+                        if winner.get("request_id"):
+                            trace.request_id = winner["request_id"]
+                        dispatch.end()
+                        self.tracer.finish(trace)
                     return winner
             # every attempt of this round failed -> failover continues
             # with the replicas not yet tried

@@ -38,6 +38,7 @@ import numpy as np
 from trlx_tpu.inference.adapters import AdapterCapacityError, AdapterError
 from trlx_tpu.inference.metrics import InferenceMetrics
 from trlx_tpu.inference.paging import KVPoolExhaustedError
+from trlx_tpu.observability.tracing import Span
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -73,6 +74,14 @@ class InferenceRequest:
     max_new_tokens: int
     deadline: Optional[float]  # absolute time.monotonic()
     adapter_id: Optional[str] = None  # multi-tenant: None = base policy
+    # server/router-assigned id (echoed in every reply and error body)
+    request_id: Optional[str] = None
+    # admission pipeline position — constant interned strings, maintained
+    # even with tracing off so a 504 can always say which stage the
+    # request died in: queued -> admitted -> prefill -> decode
+    stage: str = "queued"
+    # live RequestTrace when inference.tracing is on (None otherwise)
+    trace: Optional[object] = field(default=None, repr=False)
     enqueue_time: float = field(default_factory=time.monotonic)
     token_ids: List[int] = field(default_factory=list)
     # per-token policy logprobs (raw-logit log-softmax at each emitted
@@ -109,8 +118,14 @@ class Scheduler:
         fair_share: bool = False,
         tenant_weights: Optional[Dict[str, float]] = None,
         tenant_queue_depth: int = 0,
+        tracer=None,
+        recorder=None,
     ):
         self.engine = engine
+        # observability (both None unless inference.tracing is on; every
+        # use is guarded so the flag-off hot path allocates nothing)
+        self.tracer = tracer
+        self.recorder = recorder
         self.max_queue_depth = int(max_queue_depth)
         self.max_wait_s = float(max_wait_s)
         self.default_deadline_s = default_deadline_s
@@ -209,9 +224,16 @@ class Scheduler:
                 raise RuntimeError("scheduler is not running")
             if self._rejecting:
                 self.metrics.inc("requests_rejected_total", len(reqs))
+                if self.recorder is not None:
+                    self.recorder.record("reject", reason="draining", n=len(reqs))
                 raise DrainingError(retry_after=self._predicted_retry_after())
             if len(self._queue) + len(reqs) > self.max_queue_depth:
                 self.metrics.inc("requests_rejected_total", len(reqs))
+                if self.recorder is not None:
+                    self.recorder.record(
+                        "reject", reason="queue_full",
+                        depth=len(self._queue), n=len(reqs),
+                    )
                 raise QueueFullError(
                     len(self._queue), retry_after=self._predicted_retry_after()
                 )
@@ -237,6 +259,8 @@ class Scheduler:
         max_new_tokens: Optional[int] = None,
         deadline_s: Optional[float] = None,
         adapter_id: Optional[str] = None,
+        request_id: Optional[str] = None,
+        trace=None,
     ) -> InferenceRequest:
         ids, max_new = self._validate(prompt_ids, max_new_tokens, adapter_id)
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
@@ -246,6 +270,8 @@ class Scheduler:
             max_new_tokens=max_new,
             deadline=(time.monotonic() + dl) if dl else None,
             adapter_id=adapter_id,
+            request_id=request_id,
+            trace=trace,
         )
         self._enqueue([req])
         return req
@@ -257,6 +283,8 @@ class Scheduler:
         max_new_tokens: Optional[int] = None,
         deadline_s: Optional[float] = None,
         adapter_id: Optional[str] = None,
+        request_id: Optional[str] = None,
+        traces: Optional[List] = None,
     ) -> List[InferenceRequest]:
         """GRPO-style fan-out: enqueue `n` independent generations of one
         prompt as ADJACENT queue entries under one lock, so the paged
@@ -275,8 +303,10 @@ class Scheduler:
                 max_new_tokens=max_new,
                 deadline=deadline,
                 adapter_id=adapter_id,
+                request_id=request_id,
+                trace=(traces[i] if traces else None),
             )
-            for _ in range(n)
+            for i in range(n)
         ]
         self._enqueue(reqs)
         return reqs
@@ -412,6 +442,13 @@ class Scheduler:
         for req in leftovers:
             req.finish_reason = "shutdown"
             req.finish_time = time.monotonic()
+            if req.trace is not None:
+                req.trace.attrs["finish_reason"] = "shutdown"
+                req.trace.attrs["stage"] = req.stage
+                if self.tracer is not None:
+                    self.tracer.finish(req.trace)
+                else:
+                    req.trace.finish(req.finish_time)
             req._done.set()
         self._slot_req.clear()
         self._free = list(range(self.engine.num_slots))
@@ -523,6 +560,7 @@ class Scheduler:
         return batch, slots, budget
 
     def _admit(self) -> None:
+        t_admit0 = time.monotonic() if self.tracer is not None else 0.0
         with self._cond:
             if self._paused or not self._queue or not self._free:
                 return
@@ -555,6 +593,21 @@ class Scheduler:
                 return
             self._admitting = list(batch)
             self.metrics.set_gauge("queue_depth", len(self._queue))
+        if self.tracer is not None:
+            t_pop = time.monotonic()
+            for req in batch:
+                if req.trace is not None:
+                    req.trace.add("queue_wait", req.enqueue_time, t_admit0)
+                    req.trace.add(
+                        "admission", t_admit0, t_pop,
+                        fair_share=self.fair_share, batch=len(batch),
+                    )
+        for req in batch:
+            req.stage = "admitted"
+        if self.recorder is not None:
+            self.recorder.record(
+                "admit", batch=len(batch), queue_depth=len(self._queue),
+            )
         try:
             self._insert_batch(batch, slots)
         finally:
@@ -563,6 +616,10 @@ class Scheduler:
         self._sync_kv_metrics()
 
     def _requeue(self, batch: List[InferenceRequest], slots: List[int]) -> None:
+        for req in batch:
+            req.stage = "queued"
+        if self.recorder is not None:
+            self.recorder.record("requeue", n=len(batch))
         with self._cond:
             self._queue.extendleft(reversed(batch))
             self._free.extend(slots)
@@ -572,6 +629,18 @@ class Scheduler:
         """Prefill an admitted batch into its slots, shrinking the batch
         under adapter-capacity pressure so admission always progresses."""
         multi_tenant = getattr(self.engine, "multi_tenant", False)
+        traced = self.tracer is not None and any(
+            r.trace is not None for r in batch
+        )
+        ts0 = 0.0
+        if traced:
+            # hand the engine a buffer: it appends (name, t0, t1, attrs)
+            # tuples for adapter loads, block placement, and per-bucket
+            # prefill dispatches; they become children of "prefill"
+            self.engine.trace_buf = []
+            ts0 = time.monotonic()
+        for req in batch:
+            req.stage = "prefill"
         while True:
             rows = (
                 [(r.prompt_ids, r.max_new_tokens, r.adapter_id) for r in batch]
@@ -599,6 +668,8 @@ class Scheduler:
                     # a single adapter that cannot pin means every store
                     # slot is held by in-flight work — requeue and retry
                     # once those requests finish
+                    if traced:
+                        self.engine.trace_buf = None
                     self._requeue(batch, slots)
                     return
                 shed = tenants[-1]
@@ -619,13 +690,28 @@ class Scheduler:
                 # the probe counted as shared got evicted mid-placement);
                 # the engine rolled the whole call back — requeue in
                 # order and retry once blocks / adapter slots free
+                if traced:
+                    self.engine.trace_buf = None
                 self._requeue(batch, slots)
                 return
         self.metrics.observe("prefill_latency_seconds", time.perf_counter() - t0)
         self.metrics.inc("prefill_batches_total")
+        if traced:
+            ts1 = time.monotonic()
+            buf = getattr(self.engine, "trace_buf", None) or []
+            self.engine.trace_buf = None
+            children = []
+            for name, a, b, attrs in buf:
+                children.append(Span(name, t0=a, attrs=attrs or None).end(b))
+            for req in batch:
+                if req.trace is not None:
+                    sp = req.trace.add("prefill", ts0, ts1, batch=len(batch))
+                    sp.children.extend(children)
+                    req.trace.mark("decode_start", ts1)
         with self._cond:
             for req, slot in zip(batch, slots):
                 self._slot_req[slot] = req
+                req.stage = "decode"
             self.metrics.set_gauge("slots_active", len(self._slot_req))
             if len(self._slot_req) > self._slots_active_peak:
                 self._slots_active_peak = len(self._slot_req)
@@ -633,6 +719,7 @@ class Scheduler:
 
     def _decode_once(self) -> None:
         t0 = time.perf_counter()
+        m0 = time.monotonic() if self.tracer is not None else 0.0
         tokens, logprobs, valid, finished = self.engine.step()
         dt = time.perf_counter() - t0
         self.metrics.observe("decode_step_latency_seconds", dt)
@@ -684,6 +771,13 @@ class Scheduler:
                 "adapter_tokens_generated_total", n, labels={"adapter": t}
             )
         self.metrics.record_token_rate(emitted, dt)
+        if self.tracer is not None and self.tracer.sample_decode_step():
+            self.tracer.add_aggregate(
+                Span(
+                    "decode_step", t0=m0,
+                    attrs={"slots": len(self._slot_req), "tokens": emitted},
+                ).end(m0 + dt)
+            )
         self._sync_kv_metrics()
 
     def _sync_kv_metrics(self) -> None:
@@ -721,6 +815,32 @@ class Scheduler:
     def _finish_request(self, req: InferenceRequest, reason: str) -> None:
         req.finish_reason = reason
         req.finish_time = time.monotonic()
+        if req.trace is not None:
+            t_dec = req.trace.marks.get("decode_start")
+            if t_dec is not None:
+                req.trace.add(
+                    "decode", t_dec, req.finish_time,
+                    status=("ok" if reason in ("eos", "length") else reason),
+                    tokens=len(req.token_ids),
+                )
+            elif req.stage == "queued":
+                # died waiting (queue-deadline expiry / shutdown): the
+                # whole lifetime was queue wait
+                req.trace.add(
+                    "queue_wait", req.enqueue_time, req.finish_time,
+                    status=reason,
+                )
+            req.trace.attrs["finish_reason"] = reason
+            req.trace.attrs["stage"] = req.stage
+            if self.tracer is not None:
+                self.tracer.finish(req.trace)
+            else:
+                req.trace.finish(req.finish_time)
+        if self.recorder is not None:
+            self.recorder.record(
+                "finish", req=req.request_id or req.id, reason=reason,
+                stage=req.stage, tokens=len(req.token_ids),
+            )
         self.metrics.inc(f'requests_total{{outcome="{reason}"}}')
         if req.latency_s is not None:
             self.metrics.observe("request_latency_seconds", req.latency_s)

@@ -24,6 +24,15 @@ Endpoints:
 - ``GET/POST /admin/adapters`` — multi-tenant control plane: GET lists
   resident + on-disk adapters and store stats; POST takes one of
   ``{"load": name}`` / ``{"evict": name}`` / ``{"reload": name}``.
+- ``GET /debug/trace?last=N`` — the last N completed request traces
+  (span trees, JSON), when ``inference.tracing`` is on.
+
+Every POST /generate gets a ``request_id`` at ingress (``X-Request-Id``
+header or freshly minted) that appears in the reply, every error body,
+and the request log line. With tracing on, a router-supplied trace id
+(payload ``trace_id`` or ``X-Trace-Id`` header) threads the replica's
+spans into the caller's cross-process timeline via the reply's
+``trace`` field.
 
 Hot-reload: with `watch_dir` set, a daemon thread polls for the newest
 **manifest-complete** checkpoint (PR 1's `resilience` validation — a
@@ -37,6 +46,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -45,6 +55,7 @@ import numpy as np
 from trlx_tpu import resilience
 from trlx_tpu.inference.adapters import AdapterError
 from trlx_tpu.inference.scheduler import DrainingError, QueueFullError, Scheduler
+from trlx_tpu.observability.tracing import new_id
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -232,10 +243,14 @@ class InferenceServer:
         fault_injector: Optional["resilience.FaultInjector"] = None,
         checkpoint_loader=load_checkpoint_params,
         drain_on_term_s: float = 30.0,
+        tracer=None,
     ):
         self.scheduler = scheduler
         self.engine = scheduler.engine
         self.metrics = scheduler.metrics
+        # one tracer per replica, shared with the scheduler: the server
+        # opens traces at ingress, the scheduler closes them at finish
+        self.tracer = tracer if tracer is not None else getattr(scheduler, "tracer", None)
         self.tokenizer = tokenizer
         self.host = host
         self.port = port
@@ -280,7 +295,8 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
 
-    def _handle_generate(self, payload: Dict) -> Dict:
+    def _handle_generate(self, payload: Dict,
+                         request_id: Optional[str] = None) -> Dict:
         if "prompt_ids" in payload:
             ids = np.asarray(payload["prompt_ids"], np.int32).reshape(-1)
         elif "prompt" in payload:
@@ -293,7 +309,7 @@ class InferenceServer:
             raise ValueError("payload needs 'prompt' or 'prompt_ids'")
         unsupported = set(payload) - {
             "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n",
-            "adapter_id",
+            "adapter_id", "trace_id",
         }
         if unsupported:
             raise ValueError(
@@ -302,12 +318,24 @@ class InferenceServer:
             )
         n = int(payload.get("n", 1))
         adapter_id = payload.get("adapter_id")
+        tracer = self.tracer
+        traces = None
+        if tracer is not None:
+            # trace_id arrives from the router (payload or X-Trace-Id
+            # header, merged by the handler); absent = locally originated
+            trace_id = payload.get("trace_id")
+            traces = [
+                tracer.new_trace(trace_id=trace_id, request_id=request_id)
+                for _ in range(n)
+            ]
         if n == 1:
             reqs = [self.scheduler.submit(
                 ids,
                 max_new_tokens=payload.get("max_new_tokens"),
                 deadline_s=payload.get("deadline_s"),
                 adapter_id=adapter_id,
+                request_id=request_id,
+                trace=(traces[0] if traces else None),
             )]
         else:
             # GRPO-style fan-out: one prompt, n independent completions —
@@ -318,9 +346,20 @@ class InferenceServer:
                 max_new_tokens=payload.get("max_new_tokens"),
                 deadline_s=payload.get("deadline_s"),
                 adapter_id=adapter_id,
+                request_id=request_id,
+                traces=traces,
             )
         for req in reqs:
             req.wait()
+        # anchor the serialize span at the scheduler's finish timestamp
+        # (the decode span's end) so the handler wake-up latency is
+        # attributed to the reply handoff instead of an untraced gap
+        t_ser0 = 0.0
+        if traces is not None:
+            t_ser0 = min(
+                (r.finish_time for r in reqs if r.finish_time is not None),
+                default=time.monotonic(),
+            )
         step = self._effective_checkpoint_step()
 
         def seq(req):
@@ -334,12 +373,25 @@ class InferenceServer:
                 # the staleness bound per-reply, not just per-probe
                 "checkpoint_step": step,
             }
+            if request_id is not None:
+                out["request_id"] = request_id
+            if req.finish_reason not in ("eos", "length"):
+                # which pipeline stage the request died in — the 504
+                # body surfaces this (satellite: stage attribution)
+                out["stage"] = req.stage
             if self.tokenizer is not None:
                 out["text"] = self.tokenizer.decode(req.token_ids)
             return out
 
         if n == 1:
-            return seq(reqs[0])
+            out = seq(reqs[0])
+            if traces is not None:
+                # reply-build time (incl. detokenization); the final
+                # json.dumps + socket write is sub-ms and not covered
+                traces[0].add("serialize", t_ser0, time.monotonic())
+                out["trace_id"] = traces[0].trace_id
+                out["trace"] = traces[0].to_dict()["spans"]
+            return out
         reasons = [r.finish_reason for r in reqs]
         if "shutdown" in reasons:
             worst = "shutdown"
@@ -347,12 +399,26 @@ class InferenceServer:
             worst = "deadline"
         else:
             worst = reasons[0]
-        return {
+        result = {
             "n": n,
             "sequences": [seq(r) for r in reqs],
             "finish_reason": worst,
             "checkpoint_step": step,
         }
+        if request_id is not None:
+            result["request_id"] = request_id
+        if worst not in ("eos", "length"):
+            bad = next(r for r in reqs if r.finish_reason == worst)
+            result["stage"] = bad.stage
+        if traces is not None:
+            t_ser1 = time.monotonic()
+            merged = []
+            for tr in traces:
+                tr.add("serialize", t_ser0, t_ser1)
+                merged.extend(tr.to_dict()["spans"])
+            result["trace_id"] = traces[0].trace_id
+            result["trace"] = merged
+        return result
 
     # ------------------------------------------------------------------
     # Admin surface (fleet supervisor orchestration)
@@ -459,6 +525,14 @@ class InferenceServer:
                 if path not in ("", "/generate"):
                     self.send_error(404)
                     return
+                # every request gets an id at ingress (client-supplied or
+                # fresh) — echoed in the reply, every error body, and the
+                # request log line, tracing on or off
+                rid = self.headers.get("X-Request-Id") or new_id()
+                self._rid = rid
+                # correlate this request's log lines (JSON log format
+                # emits these as trace_id/request_id fields)
+                logging.set_trace_context(request_id=rid)
                 injector = server.fault_injector
                 slow_through = False
                 if injector is not None and injector.should_fail():
@@ -489,16 +563,28 @@ class InferenceServer:
                         time.sleep(injector.slow_s)
                         slow_through = True
                     if not slow_through:
-                        self._reply_json(503, {"error": "injected transient failure"})
+                        self._reply_json(503, {
+                            "error": "injected transient failure",
+                            "request_id": rid,
+                        })
                         return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    result = server._handle_generate(payload)
+                    if "trace_id" not in payload:
+                        hdr_tid = self.headers.get("X-Trace-Id")
+                        if hdr_tid:
+                            payload["trace_id"] = hdr_tid
+                    if payload.get("trace_id"):
+                        logging.set_trace_context(
+                            trace_id=payload["trace_id"], request_id=rid
+                        )
+                    result = server._handle_generate(payload, request_id=rid)
                 except QueueFullError as e:
                     self._reply_json(
                         503,
-                        {"error": "queue full, retry later", "queue_depth": e.depth},
+                        {"error": "queue full, retry later", "queue_depth": e.depth,
+                         "request_id": rid},
                         headers={"Retry-After": str(max(1, int(e.retry_after)))},
                     )
                     return
@@ -507,25 +593,47 @@ class InferenceServer:
                     # drain): transient — routers fail over elsewhere
                     self._reply_json(
                         503,
-                        {"error": "server draining, retry elsewhere"},
+                        {"error": "server draining, retry elsewhere",
+                         "request_id": rid},
                         headers={"Retry-After": str(max(1, int(e.retry_after)))},
                     )
                     return
                 except (ValueError, TypeError) as e:
-                    self._reply_json(400, {"error": str(e)})
+                    self._reply_json(400, {"error": str(e), "request_id": rid})
                     return
                 except Exception as e:  # surface engine errors to the client
-                    self._reply_json(500, {"error": repr(e)})
+                    self._reply_json(500, {"error": repr(e), "request_id": rid})
                     return
                 if result["finish_reason"] == "deadline":
+                    # result carries "stage": which pipeline stage the
+                    # request died in (queued / admitted / prefill / decode)
                     self._reply_json(504, {"error": "deadline exceeded", **result})
                 elif result["finish_reason"] == "shutdown":
-                    self._reply_json(503, {"error": "server shutting down"})
+                    self._reply_json(503, {
+                        "error": "server shutting down", "request_id": rid,
+                    })
                 else:
                     self._reply_json(200, result)
 
             def do_GET(self):  # noqa: N802
                 path = self.path.rstrip("/")
+                if path.split("?")[0] == "/debug/trace":
+                    if server.tracer is None:
+                        self._reply_json(404, {
+                            "error": "tracing is off (set inference.tracing)",
+                        })
+                        return
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlparse(self.path).query
+                    )
+                    try:
+                        last = int(query.get("last", ["32"])[0])
+                    except ValueError:
+                        last = 32
+                    self._reply_json(200, {
+                        "traces": server.tracer.recent(last),
+                    })
+                    return
                 if path == "/admin/adapters":
                     try:
                         self._reply_json(200, server._adapter_snapshot())
@@ -592,7 +700,11 @@ class InferenceServer:
                 self.send_error(404)
 
             def log_message(self, fmt, *args):
-                logger.debug("inference-server: " + fmt % args)
+                msg = fmt % args
+                rid = getattr(self, "_rid", None)
+                if rid is not None:
+                    msg = f"{msg} request_id={rid}"
+                logger.debug("inference-server: " + msg)
 
         return Handler
 

@@ -270,9 +270,16 @@ class FleetSupervisor:
         metrics_port: Optional[int] = None,
         fault_injector: Optional["resilience.FaultInjector"] = None,
         tick_s: float = 0.05,
+        recorder=None,
+        postmortem_dir: Optional[str] = None,
     ):
         if num_replicas < 1:
             raise ValueError("FleetSupervisor needs at least one replica")
+        # observability: every `_event` mirrors into the flight recorder
+        # (when one is wired), and a seat quarantine triggers a one-shot
+        # postmortem bundle into `postmortem_dir` (when set)
+        self.recorder = recorder
+        self.postmortem_dir = postmortem_dir
         self.replica_factory = replica_factory
         self.num_replicas = int(num_replicas)
         self.probe_interval_s = float(probe_interval_s)
@@ -406,6 +413,8 @@ class FleetSupervisor:
         ev = {"t": round(time.monotonic() - self._t0, 3), "kind": kind,
               "seat": seat.index if seat is not None else None, **detail}
         self.events.append(ev)
+        if self.recorder is not None:
+            self.recorder.record(kind, seat=ev["seat"], **detail)
         logger.info(f"fleet-supervisor: {kind} " + json.dumps(ev))
 
     def _spawn(self, seat: _Seat) -> None:
@@ -461,6 +470,18 @@ class FleetSupervisor:
             seat.state = QUARANTINED
             self.counters["quarantines"] += 1
             self._event("quarantined", seat, deaths_in_window=recent)
+            if self.postmortem_dir is not None:
+                from trlx_tpu.observability.postmortem import maybe_dump
+                maybe_dump(
+                    f"supervisor-quarantine-seat{seat.index}",
+                    out_dir=self.postmortem_dir,
+                    detail={
+                        "seat": seat.index, "reason": reason,
+                        "deaths_in_window": recent,
+                        "events": list(self.events),
+                    },
+                    metrics_render=self.render_metrics(),
+                )
         else:
             seat.state = BACKOFF
             seat.next_spawn_at = now + seat.backoff_s

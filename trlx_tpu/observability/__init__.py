@@ -1,0 +1,47 @@
+"""End-to-end observability for the serving fleet and training loop:
+request tracing (`Tracer`/`Span`/`RequestTrace`), the training phase
+timeline (`PhaseTimeline`), per-component flight recorders, the
+postmortem bundler, and the Chrome-trace/Perfetto exporter.
+
+Everything here is dependency-free and OFF by default — components hold
+`tracer = None` / `recorder = None` unless `train.tracing` /
+`inference.tracing` is set. See docs/observability.md.
+"""
+
+from trlx_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    all_recorders,
+    snapshot_all,
+)
+from trlx_tpu.observability.postmortem import (
+    dump_postmortem,
+    maybe_dump,
+    reset_triggers,
+)
+from trlx_tpu.observability.tracing import (
+    EPOCH_OFFSET,
+    PhaseTimeline,
+    RequestTrace,
+    Span,
+    Tracer,
+    new_id,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+
+__all__ = [
+    "EPOCH_OFFSET",
+    "FlightRecorder",
+    "PhaseTimeline",
+    "RequestTrace",
+    "Span",
+    "Tracer",
+    "all_recorders",
+    "dump_postmortem",
+    "maybe_dump",
+    "new_id",
+    "reset_triggers",
+    "snapshot_all",
+    "to_chrome_trace",
+    "write_chrome_trace",
+]

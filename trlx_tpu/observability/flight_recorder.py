@@ -1,0 +1,68 @@
+"""Per-component bounded ring-buffer flight recorder.
+
+Each serving/training component (scheduler, engine, supervisor,
+sentinel, router) holds its own `FlightRecorder`: a fixed-capacity deque
+of timestamped events that keeps "the last N things that happened" at
+negligible cost, so a postmortem can reconstruct the seconds before a
+watchdog fire / sentinel abort / seat quarantine. Components hold
+`recorder = None` by default and guard every record site with
+`if recorder is not None` — flag off, the hot paths allocate nothing.
+
+A process-wide weak registry lets the postmortem bundler find every live
+recorder without any component knowing about the others; recorders die
+with their component (tests churn thousands — the registry must not pin
+them).
+"""
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_registry: "weakref.WeakSet" = weakref.WeakSet()
+_registry_lock = threading.Lock()
+
+
+class FlightRecorder:
+    """Bounded event ring for one component. `record` is safe from any
+    thread; `snapshot` returns a consistent copy."""
+
+    def __init__(self, component: str, capacity: int = 512):
+        self.component = str(component)
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.dropped = 0  # events evicted by the ring bound
+        with _registry_lock:
+            _registry.add(self)
+
+    def record(self, kind: str, **detail) -> None:
+        ev = {"ts": time.time(), "component": self.component,
+              "kind": str(kind), **detail}
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+def all_recorders() -> List[FlightRecorder]:
+    with _registry_lock:
+        return sorted(_registry, key=lambda r: r.component)
+
+
+def snapshot_all(recorders: Optional[List[FlightRecorder]] = None) -> List[Dict[str, Any]]:
+    """Every component's events merged into one time-ordered stream."""
+    events: List[Dict[str, Any]] = []
+    for rec in (recorders if recorders is not None else all_recorders()):
+        events.extend(rec.snapshot())
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events

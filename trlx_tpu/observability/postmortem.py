@@ -1,0 +1,117 @@
+"""Postmortem bundler: when something already went wrong (StepWatchdog
+fire, sentinel rewind/abort, supervisor seat quarantine), dump everything
+a human needs into `logs/postmortems/<ts>-<trigger>/`:
+
+- ``trigger.json``  — what fired, when, and any caller-supplied detail
+- ``events.jsonl``  — the merged flight-recorder streams (one event/line)
+- ``threads.txt``   — every thread's stack at dump time
+- ``metrics.prom``  — the last metrics render (when the caller has one)
+- ``config.json``   — the run config (when the caller has one)
+
+`maybe_dump` is the once-per-trigger entry point: a process-wide registry
+of fired trigger keys guarantees a bundle is written exactly once per
+distinct trigger, no matter how many layers observe the same failure
+(the watchdog can fire while the sentinel is mid-rewind; the supervisor
+can quarantine two seats of the same crash loop). Dumping is best-effort
+and never raises into the failing path it documents.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from trlx_tpu.observability.flight_recorder import snapshot_all
+from trlx_tpu.utils import logging
+
+logger = logging.get_logger(__name__)
+
+_fired: set = set()
+_fired_lock = threading.Lock()
+
+
+def reset_triggers() -> None:
+    """Forget fired trigger keys (tests)."""
+    with _fired_lock:
+        _fired.clear()
+
+
+def _thread_stacks() -> str:
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines: List[str] = []
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} (ident {ident}) ---")
+        lines.extend(l.rstrip("\n") for l in traceback.format_stack(frame))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def dump_postmortem(
+    trigger: str,
+    out_dir: str = "logs/postmortems",
+    detail: Optional[Dict[str, Any]] = None,
+    recorders: Optional[List] = None,
+    metrics_render: Optional[str] = None,
+    config: Optional[Dict[str, Any]] = None,
+) -> Optional[str]:
+    """Write one bundle unconditionally; returns its directory (None only
+    when even creating the directory failed)."""
+    ts = time.strftime("%Y%m%d-%H%M%S", time.localtime())
+    slug = "".join(c if c.isalnum() or c in "-_" else "-" for c in str(trigger))[:64]
+    path = os.path.join(out_dir, f"{ts}-{slug}")
+    try:
+        suffix = 0
+        while os.path.exists(path):
+            suffix += 1
+            path = os.path.join(out_dir, f"{ts}-{slug}.{suffix}")
+        os.makedirs(path)
+    except OSError as e:
+        logger.warning(f"postmortem: cannot create bundle dir {path}: {e}")
+        return None
+
+    def write(name: str, body: str) -> None:
+        try:
+            with open(os.path.join(path, name), "w") as f:
+                f.write(body)
+        except Exception as e:  # pragma: no cover - best effort
+            logger.warning(f"postmortem: failed writing {name}: {e}")
+
+    write("trigger.json", json.dumps({
+        "trigger": str(trigger),
+        "time": time.time(),
+        "time_str": time.strftime("%Y-%m-%d %H:%M:%S %z", time.localtime()),
+        **({"detail": detail} if detail else {}),
+    }, indent=2, default=str))
+    try:
+        events = snapshot_all(recorders)
+    except Exception:  # pragma: no cover - best effort
+        events = []
+    write("events.jsonl",
+          "".join(json.dumps(e, default=str) + "\n" for e in events))
+    try:
+        write("threads.txt", _thread_stacks())
+    except Exception:  # pragma: no cover - best effort
+        pass
+    if metrics_render is not None:
+        write("metrics.prom", str(metrics_render))
+    if config is not None:
+        write("config.json", json.dumps(config, indent=2, default=str))
+    logger.warning(f"postmortem: bundle written to {path} (trigger: {trigger})")
+    return path
+
+
+def maybe_dump(trigger_key: str, trigger: Optional[str] = None, **kwargs) -> Optional[str]:
+    """Dump at most once per `trigger_key`; returns the bundle dir on the
+    first call for a key, None on repeats (or on failure)."""
+    with _fired_lock:
+        if trigger_key in _fired:
+            return None
+        _fired.add(trigger_key)
+    try:
+        return dump_postmortem(trigger if trigger is not None else trigger_key, **kwargs)
+    except Exception:  # pragma: no cover - never raise into a failing path
+        logger.exception("postmortem: dump failed")
+        return None

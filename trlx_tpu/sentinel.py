@@ -460,9 +460,15 @@ class StepWatchdog:
         timeout_s: float,
         on_timeout=None,
         poll_s: Optional[float] = None,
+        on_fire=None,
     ):
         self.timeout_s = float(timeout_s)
         self.on_timeout = on_timeout
+        # diagnostics hook invoked after the faulthandler dump but BEFORE
+        # on_timeout/exit (the trainer wires the postmortem bundler here —
+        # it must run while the wedged threads still exist). Best-effort:
+        # a failing hook must never block the exit path.
+        self.on_fire = on_fire
         self.poll_s = poll_s if poll_s is not None else max(min(self.timeout_s / 4.0, 1.0), 0.01)
         self.fired = False
         self._last_beat = time.monotonic()
@@ -504,6 +510,11 @@ class StepWatchdog:
             sys.stderr.flush()
         except Exception:
             pass
+        if self.on_fire is not None:
+            try:
+                self.on_fire()
+            except Exception:
+                logger.exception("Step watchdog: on_fire hook failed")
         if self.on_timeout is not None:
             self.on_timeout()
         else:

@@ -31,6 +31,7 @@ import optax
 from flax import traverse_util
 
 from trlx_tpu import resilience
+from trlx_tpu.observability import PhaseTimeline
 from trlx_tpu.sentinel import LAST_GOOD_NAME, HealthSentinel, SentinelRewind, StepWatchdog
 from trlx_tpu.data.configs import TRLConfig
 from trlx_tpu.models import resolve_split, trainable_mask
@@ -192,6 +193,14 @@ class TPUTrainer(BaseRLTrainer):
         # assign a resilience.FaultInjector with nan_grad_steps /
         # loss_spike_steps / hang_steps before learn().
         self.fault_injector: Optional[resilience.FaultInjector] = None
+        # Observability (train.tracing, default off): the phase timeline
+        # collects generate/score/train-minibatch spans with first-call
+        # (jit compile) time split from steady state; drained into
+        # `timing/*` stats every step and written as a Chrome trace at
+        # the end of learn(). _last_stats keeps the latest host-side
+        # stats dict for postmortem bundles.
+        self._timeline = PhaseTimeline() if config.train.tracing else None
+        self._last_stats: Dict[str, Any] = {}
         self._loop_pos: Optional[Dict[str, int]] = None
         self._resume_pos: Optional[Dict[str, int]] = None
         self._resume_dir: Optional[str] = None
@@ -496,6 +505,15 @@ class TPUTrainer(BaseRLTrainer):
             multi_tenant=icfg.multi_tenant,
             adapter_store=adapter_store,
         )
+        tracer = recorder = None
+        if icfg.tracing:
+            from trlx_tpu.observability import FlightRecorder, Tracer
+
+            tracer = Tracer(
+                max_traces=icfg.trace_ring,
+                sample_rate=icfg.trace_sample_rate,
+            )
+            recorder = FlightRecorder("scheduler", icfg.flight_recorder_events)
         scheduler = Scheduler(
             engine,
             max_queue_depth=icfg.max_queue_depth,
@@ -504,6 +522,8 @@ class TPUTrainer(BaseRLTrainer):
             fair_share=icfg.fair_share and icfg.multi_tenant,
             tenant_weights=icfg.tenant_weights,
             tenant_queue_depth=icfg.tenant_queue_depth,
+            tracer=tracer,
+            recorder=recorder,
         )
         server = InferenceServer(
             scheduler,
@@ -883,7 +903,9 @@ class TPUTrainer(BaseRLTrainer):
             # boundaries and per rollout chunk; a wedged step dumps all
             # thread stacks and exits 75 so auto_resume takes over
             self._watchdog = StepWatchdog(
-                self.config.train.step_timeout_s, on_timeout=self._watchdog_on_timeout
+                self.config.train.step_timeout_s,
+                on_timeout=self._watchdog_on_timeout,
+                on_fire=self._watchdog_postmortem,
             ).start()
 
         try:
@@ -918,6 +940,15 @@ class TPUTrainer(BaseRLTrainer):
             if getattr(self, "_profiling", False):
                 jax.profiler.stop_trace()
                 self._profiling = False
+            if self._timeline is not None:
+                trace_dir = self.config.train.trace_dir or "logs/traces"
+                try:
+                    path = self._timeline.write(
+                        os.path.join(trace_dir, "train_timeline.json")
+                    )
+                    logger.info(f"Phase timeline (Perfetto) written to {path}")
+                except Exception:
+                    logger.exception("failed to write the phase timeline")
 
     def _next_pos(self, epoch_idx: int, inner_idx: int) -> Dict[str, int]:
         """Continuation position AFTER inner epoch (epoch_idx, inner_idx)
@@ -1047,7 +1078,13 @@ class TPUTrainer(BaseRLTrainer):
                     if mb_idx < skip_steps:
                         continue  # already trained before the preemption
                     self._maybe_profile_step()
-                    stats = self.train_minibatch(minibatch)
+                    if self._timeline is not None:
+                        with self._timeline.phase(
+                            "train_minibatch", step=self.iter_count
+                        ):
+                            stats = self.train_minibatch(minibatch)
+                    else:
+                        stats = self.train_minibatch(minibatch)
                     self.iter_count += 1
                     res, best_reward, done = self._post_step(stats, clock, best_reward)
                     results = res or results
@@ -1070,6 +1107,50 @@ class TPUTrainer(BaseRLTrainer):
             self.post_epoch_callback()
         return results
 
+    def _last_metrics_render(self) -> str:
+        """The latest host-side stats, one `name value` per line — the
+        "last metrics render" file of a postmortem bundle."""
+        return "\n".join(
+            f"{k} {v}" for k, v in self._last_stats.items() if np.ndim(v) == 0
+        )
+
+    def _watchdog_postmortem(self) -> None:
+        """StepWatchdog on_fire hook: bundle flight-recorder events,
+        thread stacks, the last stats snapshot, and the run config while
+        the wedged threads still exist — before on_timeout/exit."""
+        if not self.config.train.tracing:
+            return
+        from trlx_tpu.observability.postmortem import maybe_dump
+
+        maybe_dump(
+            f"watchdog-step{self.iter_count}",
+            trigger="step-watchdog",
+            out_dir=self.config.train.postmortem_dir,
+            detail={
+                "step": self.iter_count,
+                "timeout_s": self.config.train.step_timeout_s,
+            },
+            metrics_render=self._last_metrics_render(),
+            config=self.config.to_dict(),
+        )
+
+    def _sentinel_postmortem(self, action: str, verdict) -> None:
+        """Bundle a postmortem when the sentinel rewinds or aborts (once
+        per (action, step) — a rewound run that re-trips later still
+        documents the second incident)."""
+        if not self.config.train.tracing:
+            return
+        from trlx_tpu.observability.postmortem import maybe_dump
+
+        maybe_dump(
+            f"sentinel-{action}-step{self.iter_count}",
+            trigger=f"sentinel-{action}",
+            out_dir=self.config.train.postmortem_dir,
+            detail={"step": self.iter_count, "reasons": list(verdict.reasons)},
+            metrics_render=self._last_metrics_render(),
+            config=self.config.to_dict(),
+        )
+
     def _post_step(self, stats, clock, best_reward, n_steps: int = 1):
         """Checkpoint / stats fetch / eval / best-checkpoint / logging after
         an optimizer step (or a fused inner epoch of `n_steps` steps).
@@ -1088,6 +1169,11 @@ class TPUTrainer(BaseRLTrainer):
         # overwrites the last good checkpoint
         stats = jax.device_get(_flatten_stats(stats))
         stats = {k: float(v) if np.ndim(v) == 0 else v for k, v in stats.items()}
+        if self._timeline is not None:
+            # timing/<phase>_ms (steady-state mean since the last drain)
+            # + timing/<phase>_first_ms (compile+run, reported once)
+            stats.update(self._timeline.drain_stats())
+        self._last_stats = stats
         if self._watchdog is not None:
             self._watchdog.beat()
         verdict = None
@@ -1110,9 +1196,11 @@ class TPUTrainer(BaseRLTrainer):
                 # flush this step's stats first so the post-mortem trail
                 # includes the anomaly that triggered the rewind
                 self.tracker.log(stats, step=self.iter_count)
+                self._sentinel_postmortem("rewind", verdict)
                 raise SentinelRewind(self.iter_count, verdict.reasons)
             elif verdict.action == "abort":
                 self.tracker.log(stats, step=self.iter_count)
+                self._sentinel_postmortem("abort", verdict)
                 raise FloatingPointError(
                     f"Health sentinel abort at step {self.iter_count}: "
                     + "; ".join(verdict.reasons)

@@ -9,6 +9,7 @@ forward (ops in trlx_tpu/models/policy.py), and the user reward_fn stays on
 host between the two.
 """
 
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -151,6 +152,9 @@ class PPOTrainer(TPUTrainer):
         # supervises the replicas themselves (FleetSupervisor).
         self._rollout_router = None
         self._rollout_supervisor = None
+        # router-side request tracer (train.tracing): one ring shared by
+        # every fleet dispatch, exported on fleet shutdown
+        self._rollout_tracer = None
         # optimizer step the in-process replicas' engines last received
         # params for (see _push_params_to_thread_replicas)
         self._fleet_params_step = 0
@@ -457,8 +461,23 @@ class PPOTrainer(TPUTrainer):
                 "max_staleness_steps",
                 getattr(train, "rollout_max_staleness_steps", 1),
             )
+            if train.tracing:
+                kwargs.setdefault("tracer", self._get_rollout_tracer())
             self._rollout_router = ReplicaRouter(urls, **kwargs)
         return self._rollout_router
+
+    def _get_rollout_tracer(self):
+        """Router-side tracer (train.tracing): dispatch/attempt span
+        trees with the winning replica's server-side spans grafted in."""
+        if self._rollout_tracer is None:
+            from trlx_tpu.observability import Tracer
+
+            icfg = self.config.inference
+            self._rollout_tracer = Tracer(
+                max_traces=icfg.trace_ring,
+                sample_rate=icfg.trace_sample_rate,
+            )
+        return self._rollout_tracer
 
     def _start_rollout_supervisor(self):
         """Launch the self-supervised rollout fleet: `rollout_fleet_size`
@@ -480,6 +499,18 @@ class PPOTrainer(TPUTrainer):
                 "max_staleness_steps",
                 getattr(train, "rollout_max_staleness_steps", 1),
             )
+            if train.tracing:
+                from trlx_tpu.observability import FlightRecorder
+
+                router_kwargs.setdefault("tracer", self._get_rollout_tracer())
+                sup_kwargs.setdefault(
+                    "recorder",
+                    FlightRecorder(
+                        "supervisor",
+                        self.config.inference.flight_recorder_events,
+                    ),
+                )
+                sup_kwargs.setdefault("postmortem_dir", train.postmortem_dir)
             watch_dir = sup_kwargs.pop("watch_dir", train.checkpoint_dir)
 
             def factory(seat_index):
@@ -527,6 +558,17 @@ class PPOTrainer(TPUTrainer):
             supervisor.stop()  # kills replicas + closes the router it owns
         elif router is not None:
             router.close()
+        if self._rollout_tracer is not None:
+            import os
+
+            trace_dir = self.config.train.trace_dir or "logs/traces"
+            try:
+                path = self._rollout_tracer.write_chrome_trace(
+                    os.path.join(trace_dir, "rollout_requests.json")
+                )
+                logger.info(f"Wrote rollout request trace to {path}")
+            except Exception:
+                logger.exception("Failed to write rollout request trace")
 
     def _push_params_to_thread_replicas(self) -> None:
         """Refresh in-process (ThreadReplica) seats with the live policy.
@@ -653,6 +695,7 @@ class PPOTrainer(TPUTrainer):
             self._build_score_fn()
 
         clock = Clock()
+        t_exp0 = time.monotonic()
         ppo_rl_elements: List[PPORLElement] = []
         accumulated_stats: List[Dict] = []
         method = self.config.method
@@ -698,9 +741,15 @@ class PPOTrainer(TPUTrainer):
             if len(ppo_rl_elements) + n_this < num_rollouts:
                 pending = _dispatch_next()
 
+            t_chunk0 = time.monotonic()
             clock.tick()  # reset timer
             samples = np.asarray(out["samples"])  # materialize (also syncs device)
             stats["time/rollout_generate"] = clock.tick()
+            if self._timeline is not None:
+                self._timeline.add(
+                    "rollout_generate", t_chunk0, time.monotonic(),
+                    step=iter_count, rows=n_this,
+                )
             # throughput over REAL generated tokens (the validity mask —
             # padding after eos doesn't count); tick() returns ms
             gen_s = max(stats["time/rollout_generate"] / 1000.0, 1e-9)
@@ -709,9 +758,14 @@ class PPOTrainer(TPUTrainer):
             stats["throughput/rollout_requests_per_s"] = n_this / gen_s
             self._accum_spec_stats(out, stats)
 
+            t_proc0 = time.monotonic()
             prompt_tensors, sample_outputs, outputs, scores, scores_mask = (
                 self._host_process_chunk(batch, samples, stats, clock)
             )
+            if self._timeline is not None:
+                self._timeline.add(
+                    "rollout_score", t_proc0, time.monotonic(), step=iter_count
+                )
 
             # Jitted precompute of logprobs/values/ref KL
             if self.seq2seq:
@@ -783,6 +837,10 @@ class PPOTrainer(TPUTrainer):
             ppo_rl_elements.extend(elements)
 
             stats["time/rollout_time"] = clock.tick()
+            if self._timeline is not None:
+                self._timeline.add(
+                    "rollout_process", t_proc0, time.monotonic(), step=iter_count
+                )
             stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
             stats["policy/kl_per_token"] = float(np.sqrt(max(mean_kl_per_token, 0.0)))
             accumulated_stats.append(stats)
@@ -806,6 +864,10 @@ class PPOTrainer(TPUTrainer):
                 if isinstance(v, (int, float)):
                     stats[f"fleet/{k}"] = float(v)
         self.mean_kl = stats["policy/sqrt_kl"] ** 2
+        if self._timeline is not None:
+            self._timeline.add(
+                "make_experience", t_exp0, time.monotonic(), step=iter_count
+            )
         self.tracker.log(stats, step=iter_count)
         self.push_to_store(ppo_rl_elements)
 
@@ -1722,7 +1784,16 @@ class PPOTrainer(TPUTrainer):
             fetch.extend(s[0] for s in specs)
         if prev is not None and not use_fast:
             fetch.extend(prev)
+        t_fetch0 = time.monotonic()
         fetched = jax.device_get(tuple(fetch))
+        if self._timeline is not None:
+            # the cycle's blocking device->host sync: under the fast
+            # schedule this is where generation overlap is (or isn't)
+            # hiding the previous train step
+            self._timeline.add(
+                "pipelined_fetch", t_fetch0, time.monotonic(),
+                step=self.iter_count,
+            )
         samples_list = fetched[:k]
         trimmed_list = fetched[k:2 * k] if use_spec else [None] * k
         for _, o in gens:
@@ -1851,12 +1922,20 @@ class PPOTrainer(TPUTrainer):
             # donation-safe: train's donated buffers only invalidate
             # consumers enqueued after it, and the gens are already in.
             nxt_gens, nxt_specs = dispatch_chunks()
-            stats = self.train_epochs_from_chunk(full, method.ppo_epochs)
+            stats = self._timed_train_epochs(full, method.ppo_epochs)
         else:
-            stats = self.train_epochs_from_chunk(full, method.ppo_epochs)
+            stats = self._timed_train_epochs(full, method.ppo_epochs)
             nxt_gens, nxt_specs = dispatch_chunks()
         handles = (stats["losses"]["total_loss"], mean_kl)
         return prev_loss, (nxt_gens, nxt_specs, handles)
+
+    def _timed_train_epochs(self, full, n_epochs):
+        """train_epochs_from_chunk under a "train_epochs" phase span (the
+        pipelined path bypasses _learn_loop's train_minibatch wrapper)."""
+        if self._timeline is None:
+            return self.train_epochs_from_chunk(full, n_epochs)
+        with self._timeline.phase("train_epochs", step=self.iter_count):
+            return self.train_epochs_from_chunk(full, n_epochs)
 
     def post_backward_callback(self):
         self.kl_ctl.update(self.mean_kl, n_steps=self.config.train.batch_size)

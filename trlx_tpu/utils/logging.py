@@ -4,8 +4,15 @@ Parity: trlx/utils/logging.py in the reference (HF-style verbosity control
 via the TRLX_VERBOSITY env var, a multi-process adapter that logs only on
 chosen ranks, tqdm toggling). Rank here means the JAX process index
 (multi-host), not a torch.distributed rank.
+
+TRLX_LOG_FORMAT=json switches the default handler to one-JSON-object-per
+line (`ts`, `level`, `logger`, `msg`, plus `trace_id`/`request_id` when a
+trace context is active via set_trace_context) for log aggregators. The
+default human-readable format is unchanged when the env var is unset.
 """
 
+import contextvars
+import json
 import logging
 import os
 import sys
@@ -15,6 +22,52 @@ from typing import Optional
 
 _lock = threading.Lock()
 _default_handler: Optional[logging.Handler] = None
+
+# Active trace context for log correlation. A contextvar (not a plain
+# thread-local) so request handlers running in thread pools inherit the
+# value from the context the work was submitted in.
+_trace_ctx: "contextvars.ContextVar[Optional[dict]]" = contextvars.ContextVar(
+    "trlx_trace_ctx", default=None
+)
+
+
+def set_trace_context(trace_id: Optional[str] = None,
+                      request_id: Optional[str] = None):
+    """Attach trace/request ids to subsequent log records in this context.
+    Returns a token for reset_trace_context."""
+    ctx = {}
+    if trace_id:
+        ctx["trace_id"] = trace_id
+    if request_id:
+        ctx["request_id"] = request_id
+    return _trace_ctx.set(ctx or None)
+
+
+def reset_trace_context(token) -> None:
+    _trace_ctx.reset(token)
+
+
+def get_trace_context() -> Optional[dict]:
+    return _trace_ctx.get()
+
+
+class JSONLogFormatter(logging.Formatter):
+    """One JSON object per line: ts (unix seconds), level, logger, msg,
+    and trace_id/request_id when a trace context is active."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        ctx = _trace_ctx.get()
+        if ctx:
+            obj.update(ctx)
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj, default=str)
 
 log_levels = {
     "debug": DEBUG,
@@ -54,10 +107,13 @@ def _configure_library_root_logger() -> None:
             return
         _default_handler = logging.StreamHandler()  # sys.stderr as stream
         _default_handler.flush = sys.stderr.flush
-        formatter = logging.Formatter(
-            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
-            datefmt="%H:%M:%S",
-        )
+        if os.getenv("TRLX_LOG_FORMAT", "").lower() == "json":
+            formatter: logging.Formatter = JSONLogFormatter()
+        else:
+            formatter = logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%H:%M:%S",
+            )
         _default_handler.setFormatter(formatter)
         library_root_logger = _get_library_root_logger()
         library_root_logger.addHandler(_default_handler)

@@ -1,0 +1,268 @@
+"""CI smoke: multi-turn chat sessions with retained KV over a 2-replica
+supervised paged fleet, plus one multi-turn GRPO cycle through those
+sessions.
+
+Serving half (greedy decode so everything is bitwise-checkable):
+
+  1. three 3-turn conversations through `ChatSession`: every follow-up
+     turn must take a retained-block hit (>= 1 pinned block reused) and
+     prefill ONLY its delta tokens, with 0 < ttft_s <= latency_s;
+  2. one conversation suffers a mid-run session eviction (block
+     pressure un-pins its KV, token history kept): the next turn
+     re-prefills transparently (retained_hit False), the turn after
+     retains again, and the whole conversation stays bitwise equal to
+     full-concat fresh /generate calls — as must every other
+     conversation;
+  3. token streaming: the SSE deltas of /generate and /chat concatenate
+     bitwise to their done events and to the non-streamed replies.
+
+Training half: one multi-turn GRPO experience collection + train step on
+the `calculator` tool-use environment, episodes routed through the same
+fleet's chat sessions (`ReplicaRouter.chat`). Asserts every element
+carries a loss mask, session turns were actually served, and the loss is
+finite.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/session_smoke.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FLEET_SIZE = 2
+MAX_NEW = 6
+KV_BLOCK = 8
+
+
+def post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def build_config(workdir, **overrides):
+    from trlx_tpu.data.default_configs import default_grpo_config
+
+    method = dict(num_rollouts=4, chunk_size=4, ppo_epochs=1, group_size=2,
+                  gen_kwargs=dict(max_new_tokens=MAX_NEW, do_sample=False,
+                                  eos_token_id=10_000))
+    method.update(overrides.pop("method", {}))
+    return default_grpo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=96, batch_size=4, total_steps=1, tracker=None,
+                   checkpoint_dir=os.path.join(workdir, "ckpts"), seed=11,
+                   **overrides.pop("train", {})),
+        method=method,
+        inference=dict(
+            num_slots=4, max_prompt_len=128, max_new_tokens=MAX_NEW,
+            max_wait_s=0.0,
+            gen_kwargs=dict(do_sample=False, eos_token_id=10_000),
+            kv_paging=True, kv_block_size=KV_BLOCK,
+            sessions=True, session_ttl_s=600.0,
+        ),
+    )
+
+
+def serving_checks(urls, supervisor, tok):
+    from trlx_tpu.inference.client import ChatSession, sse_stream
+
+    def store_for(url):
+        for seat in supervisor.seats:
+            server = getattr(seat.handle, "server", None)
+            if server is not None and seat.url == url:
+                return server.engine.session_store
+        raise AssertionError(f"no in-process server behind {url}")
+
+    # ---- 1+2. retained-KV conversations, one evicted mid-run ----------
+    # 3 conversations x 3 turns; conversation 1 gets evicted after its
+    # second turn and plays a fourth turn to show retention resuming
+    convs = [
+        ["summarize this passage: ab", " and then expand it.", " shorter."],
+        ["translate to French: hello", " now to German.", " and Dutch.", " thanks."],
+        ["list three colors: red,", " three animals too.", " one more."],
+    ]
+    transcripts = []
+    evicted_conv, evict_after_turn = 1, 2
+    for c, turns in enumerate(convs):
+        url = urls[c % len(urls)]
+        session = ChatSession(url, retries=0)
+        record = []
+        for t, text in enumerate(turns):
+            if c == evicted_conv and t == evict_after_turn:
+                store = store_for(url)
+                before = store.retained_blocks()
+                freed = store.evict_for_blocks(10**9)
+                assert freed >= 1, (
+                    f"block-pressure eviction freed nothing "
+                    f"({before} retained)"
+                )
+            turn_ids = tok.encode(text)
+            out = session.send(turn_ids, max_new_tokens=MAX_NEW)
+            assert out["finish_reason"] in ("eos", "length")
+            assert 0 < out["ttft_s"] <= out["latency_s"], (
+                f"TTFT not first-class: {out['ttft_s']} vs {out['latency_s']}"
+            )
+            record.append((turn_ids, out))
+            if t == 0:
+                continue
+            if c == evicted_conv and t == evict_after_turn:
+                # evicted: history kept, KV gone -> transparent re-prefill
+                assert not out["retained_hit"], "hit through evicted KV?"
+                assert out["prefill_tokens"] >= len(turn_ids)
+            else:
+                assert out["retained_hit"], (
+                    f"conv {c} turn {t}: no retained-block hit"
+                )
+                assert out["retained_blocks"] >= 1
+                assert out["prefill_tokens"] < out["session_tokens"], (
+                    f"conv {c} turn {t}: follow-up prefilled the whole "
+                    f"conversation ({out['prefill_tokens']} tokens)"
+                )
+        assert session.resets == 0, "eviction must not surface as a reset"
+        transcripts.append((url, record))
+
+    # every conversation (including the evicted one) bitwise equals
+    # full-concat fresh generates
+    for c, (url, record) in enumerate(transcripts):
+        running = []
+        for t, (turn_ids, out) in enumerate(record):
+            running += list(turn_ids)
+            fresh = post(url + "/generate",
+                         {"prompt_ids": running, "max_new_tokens": MAX_NEW})
+            assert fresh["token_ids"] == out["token_ids"], (
+                f"conv {c} turn {t}: session continuation diverged from "
+                f"full-concat generate"
+            )
+            running += list(out["token_ids"])
+
+    # ---- 3. streamed == non-streamed, bitwise -------------------------
+    prompt_ids = tok.encode(convs[0][0])
+    plain = post(urls[0] + "/generate",
+                 {"prompt_ids": list(prompt_ids), "max_new_tokens": MAX_NEW})
+    deltas, done = [], None
+    for event in sse_stream(urls[0] + "/generate",
+                            {"prompt_ids": list(prompt_ids),
+                             "max_new_tokens": MAX_NEW}):
+        if event.get("event") == "done":
+            done = event
+        else:
+            deltas += event["token_ids"]
+    assert done is not None and deltas == done["token_ids"] == plain["token_ids"]
+
+    streamed = ChatSession(urls[0], retries=0)
+    s_deltas, s_done = [], None
+    for event in streamed.stream(prompt_ids, max_new_tokens=MAX_NEW):
+        if event.get("event") == "done":
+            s_done = event
+        else:
+            s_deltas += event["token_ids"]
+    first_reply = transcripts[0][1][0][1]
+    assert s_done is not None
+    assert s_deltas == s_done["token_ids"] == first_reply["token_ids"], (
+        "streamed /chat diverged from the non-streamed conversation"
+    )
+
+    # per-replica stores: aggregate counters across the fleet
+    stats = {}
+    for url in urls:
+        for k, v in store_for(url).stats().items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["session_retained_hits_total"] >= 1
+    assert stats["session_evictions_blocks_total"] >= 1
+    n_turns = sum(len(r) for _, r in transcripts)
+    return n_turns, stats
+
+
+def main():
+    workdir = tempfile.mkdtemp(prefix="session_smoke_")
+
+    from trlx_tpu.inference.supervisor import FleetSupervisor, ThreadReplica
+    from trlx_tpu.pipeline import MiniBatchIterator
+    from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+    from trlx_tpu.trainer.grpo_trainer import GRPOTrainer
+    from trlx_tpu.utils import set_seed
+
+    server_config = build_config(workdir)
+    set_seed(server_config.train.seed)
+    server_trainer = GRPOTrainer(
+        server_config, reward_fn=lambda samples, **kw: [0.0] * len(samples)
+    )
+
+    supervisor = FleetSupervisor(
+        lambda seat_index: ThreadReplica(
+            lambda: server_trainer.serve(port=0, background=True)
+        ),
+        num_replicas=FLEET_SIZE,
+        tick_s=0.02, probe_interval_s=0.1, sync_interval_s=3600.0,
+        start_timeout_s=300.0,
+    ).start()
+    try:
+        assert supervisor.wait_ready(timeout_s=300.0), "fleet never became ready"
+        urls = [s.url for s in supervisor.seats if s.role == "active" and s.url]
+        assert len(urls) == FLEET_SIZE
+
+        n_turns, stats = serving_checks(urls, supervisor, server_trainer.tokenizer)
+
+        # ---- multi-turn GRPO cycle through fleet sessions -------------
+        trainer = GRPOTrainer(build_config(
+            workdir,
+            method=dict(multiturn_env="calculator", multiturn_max_turns=2),
+            train=dict(
+                rollout_backend="fleet",
+                rollout_fleet_urls=urls,
+                rollout_fleet_kwargs=dict(replica_retries=1, hedge=False,
+                                          probe_timeout_s=2.0),
+            ),
+        ))
+        trainer.add_prompt_pipeline(
+            PromptPipeline(["unused"], 8, trainer.tokenizer)
+        )
+        trainer.make_experience(trainer.config.method.num_rollouts)
+        history = trainer.store.history
+        assert len(history) >= trainer.config.method.num_rollouts
+        for e in history:
+            assert e.loss_mask is not None, "multiturn element missing loss mask"
+            assert len(e.loss_mask) == len(e.response_tensor)
+        gids = [e.group_id for e in history]
+        assert all(g is not None for g in gids) and gids == sorted(gids)
+
+        router_stats = trainer._rollout_router.stats()
+        assert router_stats.get("session_turns", 0) >= len(history), (
+            f"episodes did not route through chat sessions: {router_stats}"
+        )
+
+        loader = trainer.create_train_dataloader()
+        stats_out = None
+        for minibatch in MiniBatchIterator(loader, trainer.mb_size, trainer.num_mb):
+            stats_out = trainer.train_minibatch(minibatch)
+            break
+        loss = float(np.asarray(stats_out["losses"]["total_loss"]))
+        assert np.isfinite(loss), f"non-finite multiturn GRPO loss: {loss}"
+
+        print(
+            f"session smoke OK: {n_turns} chat turns on {FLEET_SIZE} paged "
+            f"replicas ({int(stats['session_retained_hits_total'])} retained "
+            f"hits, {int(stats['session_evictions_blocks_total'])} block "
+            f"eviction(s), streamed == non-streamed), "
+            f"{len(history)} multi-turn GRPO episodes "
+            f"({int(router_stats.get('session_turns', 0))} session turns), "
+            f"loss {loss:.4f}"
+        )
+    finally:
+        supervisor.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,8 @@ def _merge_bench_record(path, record=None, **sections):
     except (OSError, ValueError):
         pass
     if record is not None:
-        keep = {k: merged[k] for k in ("paged_kv",) if k in merged}
+        keep = {k: merged[k] for k in ("paged_kv", "multi_tenant", "sessions")
+                if k in merged}
         merged = {**record, **keep}
     merged.update(sections)
     with open(path, "w") as f:
@@ -257,7 +258,7 @@ def test_sustained_saturation_slo_with_replica_kill(trainer):
                 timeout=300,
             ).read()
 
-        latencies, errors = [], []
+        latencies, ttfts, errors = [], [], []
         lat_lock = threading.Lock()
         next_req = [0]
 
@@ -274,8 +275,12 @@ def test_sustained_saturation_slo_with_replica_kill(trainer):
                 try:
                     res = router.generate([prompt], max_new_tokens=SLO_MAX_NEW)[0]
                     assert res["finish_reason"] in ("eos", "length")
+                    # TTFT is first-class next to total latency: measured
+                    # server-side, it must exist and be bounded by it
+                    assert 0 < res["ttft_s"] <= res["latency_s"]
                     with lat_lock:
                         latencies.append(time.perf_counter() - t0)
+                        ttfts.append(float(res["ttft_s"]))
                         tokens_out[0] += len(res["token_ids"])
                 except Exception as e:
                     with lat_lock:
@@ -340,6 +345,8 @@ def test_sustained_saturation_slo_with_replica_kill(trainer):
             "latency_p50_s": round(p50, 4),
             "latency_p99_s": round(p99, 4),
             "latency_max_s": round(float(np.max(latencies)), 4),
+            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 4),
+            "ttft_p99_s": round(float(np.percentile(ttfts, 99)), 4),
             "dropped_requests": len(errors),
             "capacity_recovery_s": round(recovery_s, 3),
             "supervisor": {
@@ -602,5 +609,103 @@ def test_multi_tenant_skewed_load_slo(tmp_path):
             p99 = record["tenants"][tenant]["p99_s"]
             assert p99 <= MT_P99_S, f"{tenant} p99 {p99:.2f}s blew the SLO"
         assert sorted(store.resident()) == ["bg", "hot"]
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# Session turn-latency bench: retained-KV follow-up turns vs fresh
+# full-concat prefills, recorded under "sessions"
+# ----------------------------------------------------------------------
+
+SESS_CONVERSATIONS = 8
+SESS_TURNS = 3
+
+
+@pytest.mark.slow
+def test_session_multiturn_ttft_bench(trainer):
+    """Concurrent 3-turn conversations against a paged session server:
+    every follow-up turn must reuse retained blocks (delta prefill), TTFT
+    must be measured and bounded by total latency, and the per-turn TTFT
+    percentiles land in BENCH_load_slo.json under "sessions"."""
+    tok = trainer.tokenizer
+    gen_cfg = GenerationConfig(
+        max_new_tokens=8, do_sample=False,
+        eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+    )
+    engine = InferenceEngine(
+        trainer.model, trainer.model_cfg, trainer.params, gen_cfg,
+        num_slots=4, max_prompt_len=128,
+        kv_paging=True, kv_block_size=16,
+    )
+    engine.enable_sessions()
+    sched = Scheduler(engine, max_queue_depth=64, max_wait_s=0.002)
+    server = InferenceServer(sched, tokenizer=tok, host="127.0.0.1", port=0)
+    url = server.start_background()
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            url + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read().decode())
+
+    try:
+        post("/generate", {"prompt_ids": [1] * 6, "max_new_tokens": 2})  # warm
+        rng = np.random.RandomState(7)
+        first_ttfts, follow_ttfts, errors = [], [], []
+        lock = threading.Lock()
+        hits = [0]
+
+        def conversation(i):
+            try:
+                turn = rng.randint(32, 127, size=24).tolist()
+                out = post("/chat", {"prompt_ids": turn, "max_new_tokens": 8})
+                assert 0 < out["ttft_s"] <= out["latency_s"]
+                with lock:
+                    first_ttfts.append(out["ttft_s"])
+                sid = out["session_id"]
+                for _ in range(SESS_TURNS - 1):
+                    delta = rng.randint(32, 127, size=8).tolist()
+                    out = post("/chat", {"session_id": sid,
+                                         "prompt_ids": delta,
+                                         "max_new_tokens": 8})
+                    assert 0 < out["ttft_s"] <= out["latency_s"]
+                    with lock:
+                        follow_ttfts.append(out["ttft_s"])
+                        hits[0] += int(bool(out["retained_hit"]))
+            except Exception as e:
+                with lock:
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=conversation, args=(i,))
+                   for i in range(SESS_CONVERSATIONS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+
+        assert not errors, f"dropped turns: {errors[:3]}"
+        n_follow = SESS_CONVERSATIONS * (SESS_TURNS - 1)
+        assert len(follow_ttfts) == n_follow
+        # retained KV is doing its job: every follow-up turn reuses blocks
+        assert hits[0] == n_follow, f"only {hits[0]}/{n_follow} retained hits"
+
+        stats = engine.session_store.stats()
+        record = {
+            "conversations": SESS_CONVERSATIONS,
+            "turns_per_conversation": SESS_TURNS,
+            "retained_hit_rate": round(hits[0] / n_follow, 3),
+            "first_turn_ttft_p50_s": round(float(np.percentile(first_ttfts, 50)), 4),
+            "followup_ttft_p50_s": round(float(np.percentile(follow_ttfts, 50)), 4),
+            "followup_ttft_p99_s": round(float(np.percentile(follow_ttfts, 99)), 4),
+            "store": {k: v for k, v in stats.items()
+                      if isinstance(v, (int, float))},
+        }
+        out_path = os.path.join(os.path.dirname(__file__), "..",
+                                "BENCH_load_slo.json")
+        _merge_bench_record(out_path, sessions=record)
+        print(f"\nsession multiturn bench: {json.dumps(record)}")
     finally:
         server.shutdown()

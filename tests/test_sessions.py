@@ -1,0 +1,472 @@
+"""Session layer tests (trlx_tpu/inference/sessions.py + server /chat).
+
+Unit level: `SessionStore` retention/eviction/invalidation semantics over
+a raw `BlockPool`. Server level: multi-turn /chat with delta prefill,
+greedy bitwise parity against a fresh full-concat /generate, SSE token
+streaming parity, stop sequences, and the weight-swap -> 409
+`session_reset` consistency contract.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from trlx_tpu.inference import (
+    InferenceEngine,
+    InferenceServer,
+    Scheduler,
+    SessionBusyError,
+    SessionLimitError,
+    SessionResetError,
+    SessionStore,
+)
+from trlx_tpu.inference.client import ChatSession, sse_stream
+from trlx_tpu.inference.paging import BlockPool
+from trlx_tpu.ops.sampling import GenerationConfig
+
+BS = 8  # block size for the unit tests
+
+
+def make_store(num_blocks=16, **kw):
+    pool = BlockPool(num_blocks, BS)
+    kw.setdefault("ttl_s", 600.0)
+    kw.setdefault("max_sessions", 8)
+    return pool, SessionStore(pool, BS, **kw)
+
+
+def ids(n, base=0):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def simulate_turn(pool, store, sess, full_ids):
+    """One finished turn as the driver sees it: the request holds refs on
+    ceil(len/BS) slot blocks, retention pins the leading full ones, then
+    the slot's own refs release."""
+    n_blocks = -(-len(full_ids) // BS)
+    slot_blocks = pool.alloc(n_blocks)
+    kept = store.retain_turn(sess, slot_blocks, full_ids)
+    pool.release(slot_blocks)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# SessionStore unit tests
+# ---------------------------------------------------------------------------
+
+
+def test_turn_lifecycle_busy_and_adapter_guard():
+    _, store = make_store()
+    sess = store.create()
+    assert sess.busy
+    with pytest.raises(SessionBusyError):
+        store.begin_turn(sess.id)
+    store.end_turn(sess)
+    again = store.begin_turn(sess.id)
+    assert again is sess and sess.busy
+    store.end_turn(sess)
+    with pytest.raises(ValueError):
+        store.begin_turn(sess.id, adapter_id="other")
+    with pytest.raises(SessionResetError) as e:
+        store.begin_turn("nope")
+    assert e.value.reason == "unknown_session"
+
+
+def test_retain_pins_leading_full_blocks_only():
+    pool, store = make_store()
+    free0 = pool.available()
+    sess = store.create()
+    # 2*BS+3 tokens -> exactly 2 full blocks pinned
+    kept = simulate_turn(pool, store, sess, ids(2 * BS + 3))
+    assert kept == 2 and len(sess.blocks) == 2
+    assert pool.available() == free0 - 2
+    # exact block boundary: the last boundary block is NOT retained (at
+    # least one suffix token must prefill next turn)
+    sess2 = store.create()
+    kept = simulate_turn(pool, store, sess2, ids(2 * BS))
+    assert kept == 1
+    store.end_turn(sess)
+    store.end_turn(sess2)
+
+
+def test_acquire_blocks_prefix_match_and_mismatch():
+    pool, store = make_store()
+    sess = store.create()
+    history = ids(2 * BS + 3)
+    simulate_turn(pool, store, sess, history)
+    store.end_turn(sess)
+
+    # next turn extends the history: retained blocks handed out (with
+    # fresh refs), the suffix re-prefills
+    nxt = np.concatenate([history, ids(4, base=500)])
+    got = store.acquire_blocks(sess, nxt)
+    assert got == sess.blocks and len(got) == 2
+    pool.release(got)
+
+    # diverging history: clean miss, full re-prefill
+    bad = nxt.copy()
+    bad[3] += 1
+    assert store.acquire_blocks(sess, bad) == []
+    # shorter than coverage: also a miss
+    assert store.acquire_blocks(sess, history[: BS - 1]) == []
+
+
+def test_ttl_sweep_drops_idle_sessions():
+    pool, store = make_store(ttl_s=10.0)
+    sess = store.create()
+    simulate_turn(pool, store, sess, ids(2 * BS + 1))
+    store.end_turn(sess)
+    free_before = pool.available()
+    sess.last_used -= 11.0
+    assert store.sweep() == 1
+    assert pool.available() == free_before + 2  # pins released
+    with pytest.raises(SessionResetError):
+        store.begin_turn(sess.id)
+    assert store.stats()["session_evictions_ttl_total"] == 1
+
+
+def test_lru_eviction_under_session_churn():
+    _, store = make_store(max_sessions=2)
+    a = store.create()
+    store.end_turn(a)
+    b = store.create()
+    store.end_turn(b)
+    a.last_used -= 5.0  # a is LRU
+    c = store.create()
+    store.end_turn(c)
+    assert len(store) == 2 and store.get(a.id) is None
+    assert store.stats()["session_evictions_lru_total"] == 1
+    # every session busy: creating one more must refuse, not evict
+    store.begin_turn(b.id)
+    store.begin_turn(c.id)
+    with pytest.raises(SessionLimitError):
+        store.create()
+
+
+def test_evict_for_blocks_unpins_lru_but_keeps_history():
+    pool, store = make_store(num_blocks=16)
+    a = store.create()
+    simulate_turn(pool, store, a, ids(3 * BS + 1))
+    store.end_turn(a)
+    b = store.create()
+    simulate_turn(pool, store, b, ids(3 * BS + 1, base=100))
+    store.end_turn(b)
+    a.last_used -= 5.0
+
+    # demand more than the free list holds: a (LRU) loses its pins first
+    needed = pool.available() + 2
+    freed = store.evict_for_blocks(needed)
+    assert freed >= 3 and a.blocks == [] and b.blocks
+    assert store.stats()["session_evictions_blocks_total"] >= 1
+    # the session itself survives with its token history: the next turn
+    # re-prefills instead of 409ing
+    assert store.get(a.id) is not None and a.tokens.size == 3 * BS + 1
+    assert store.acquire_blocks(a, np.concatenate([a.tokens, ids(2)])) == []
+
+
+def test_invalidate_all_releases_pins_and_409s_next_turn():
+    pool, store = make_store()
+    sess = store.create()
+    simulate_turn(pool, store, sess, ids(2 * BS + 1))
+    store.end_turn(sess)
+    free_before = pool.available()
+    assert store.invalidate_all("weights_updated") == 1
+    assert pool.available() == free_before + 2
+    with pytest.raises(SessionResetError) as e:
+        store.begin_turn(sess.id)
+    assert e.value.reason == "weights_updated"
+    # the reset delivery removed the session
+    assert store.get(sess.id) is None
+
+
+def test_invalidate_adapter_only_touches_that_tenant():
+    pool, store = make_store()
+    a = store.create(adapter_id="a")
+    simulate_turn(pool, store, a, ids(BS + 1))
+    store.end_turn(a)
+    b = store.create(adapter_id="b")
+    simulate_turn(pool, store, b, ids(BS + 1, base=50))
+    store.end_turn(b)
+    assert store.invalidate_adapter("a") == 1
+    with pytest.raises(SessionResetError):
+        store.begin_turn(a.id, adapter_id="a")
+    assert store.begin_turn(b.id, adapter_id="b") is b
+
+
+def test_retain_mid_flight_after_invalidate_is_skipped():
+    """A weights swap lands while a turn is decoding: the in-flight
+    request keeps its own refs, but retention at finish is a no-op and
+    no pin outlives the swap."""
+    pool, store = make_store()
+    sess = store.create()
+    slot_blocks = pool.alloc(3)
+    store.invalidate_all("weights_updated")
+    assert store.retain_turn(sess, slot_blocks, ids(2 * BS + 1)) == 0
+    pool.release(slot_blocks)
+    assert store.retained_blocks() == 0
+
+
+def test_bytes_budget_unpins_lru_first():
+    pool, store = make_store(
+        num_blocks=32, bytes_budget=3 * 1024, block_bytes=1024
+    )
+    a = store.create()
+    simulate_turn(pool, store, a, ids(2 * BS + 1))
+    store.end_turn(a)
+    a.last_used -= 5.0
+    b = store.create()
+    simulate_turn(pool, store, b, ids(2 * BS + 1, base=100))
+    store.end_turn(b)
+    # 4 pinned blocks > 3-block budget: a (LRU, not the retainer) unpins
+    assert a.blocks == [] and len(b.blocks) == 2
+    assert store.get(a.id) is not None  # history kept
+
+
+# ---------------------------------------------------------------------------
+# Server-level /chat tests
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trainer():
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.sft_trainer import SFTTrainer
+
+    config = default_sft_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=64, total_steps=0, tracker=None, batch_size=2),
+    )
+    return SFTTrainer(config)
+
+
+def make_session_server(trainer, num_slots=2, max_new=8, sessions=True, **store_kw):
+    tok = trainer.tokenizer
+    gen_cfg = GenerationConfig(
+        max_new_tokens=max_new, do_sample=False,
+        eos_token_id=tok.eos_token_id, pad_token_id=tok.pad_token_id,
+    )
+    engine = InferenceEngine(
+        trainer.model, trainer.model_cfg, trainer.params, gen_cfg,
+        num_slots=num_slots, max_prompt_len=64,
+        kv_paging=True, kv_block_size=8,
+    )
+    if sessions:
+        engine.enable_sessions(**store_kw)
+    sched = Scheduler(engine, max_queue_depth=64, max_wait_s=0.0)
+    return InferenceServer(sched, tokenizer=tok, host="127.0.0.1", port=0)
+
+
+def _post(url, path, payload, timeout=60):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _error(url, path, payload):
+    try:
+        _post(url, path, payload)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+    raise AssertionError("expected an HTTP error")
+
+
+P1 = [72, 101, 108, 108, 111, 32, 116, 104, 101, 114, 101]  # "Hello there"
+P2 = [32, 104, 111, 119]  # " how"
+P3 = [32, 110, 111, 119, 63]  # " now?"
+
+
+@pytest.fixture(scope="module")
+def chat_server(trainer):
+    server = make_session_server(trainer, num_slots=2, max_new=8)
+    server.start_background()
+    yield server
+    server.shutdown()
+
+
+def test_chat_multi_turn_delta_prefill_bitwise(chat_server):
+    """The tentpole contract: follow-up turns prefill only their delta
+    tokens against retained KV, and the multi-turn greedy transcript is
+    bitwise identical to prefilling the whole concatenation fresh."""
+    url = chat_server.url
+    r1 = _post(url, "/chat", {"prompt_ids": P1, "max_new_tokens": 4})
+    assert r1["turn"] == 1 and not r1["retained_hit"]
+    assert r1["prefill_tokens"] == len(P1)
+    sid = r1["session_id"]
+    hist = P1 + r1["token_ids"]
+
+    r2 = _post(url, "/chat", {"session_id": sid, "prompt_ids": P2,
+                              "max_new_tokens": 4})
+    assert r2["turn"] == 2
+    assert r2["retained_hit"], "turn 2 must reuse retained blocks"
+    # delta prefill: strictly fewer tokens than the whole conversation
+    assert r2["prefill_tokens"] < len(hist) + len(P2)
+    assert r2["retained_blocks"] >= 1
+
+    g = _post(url, "/generate", {"prompt_ids": hist + P2, "max_new_tokens": 4})
+    assert g["token_ids"] == r2["token_ids"]
+
+    hist += P2 + r2["token_ids"]
+    r3 = _post(url, "/chat", {"session_id": sid, "prompt_ids": P3,
+                              "max_new_tokens": 4})
+    assert r3["retained_hit"] and r3["turn"] == 3
+    g3 = _post(url, "/generate", {"prompt_ids": hist + P3, "max_new_tokens": 4})
+    assert g3["token_ids"] == r3["token_ids"]
+
+    # TTFT is measured and sane
+    for r in (r1, r2, r3):
+        assert 0 < r["ttft_s"] <= r["latency_s"]
+
+
+def test_stream_generate_bitwise(chat_server):
+    url = chat_server.url
+    plain = _post(url, "/generate", {"prompt_ids": P1, "max_new_tokens": 6})
+    events = list(sse_stream(
+        url + "/generate",
+        {"prompt_ids": P1, "max_new_tokens": 6, "stream": True},
+    ))
+    done = [e for e in events if e.get("event") == "done"]
+    assert len(done) == 1 and done[0] is events[-1]
+    streamed = [t for e in events[:-1] for t in e.get("token_ids", [])]
+    assert streamed == plain["token_ids"]
+    assert done[0]["token_ids"] == plain["token_ids"]
+    assert done[0]["finish_reason"] == plain["finish_reason"]
+
+
+def test_stream_chat_bitwise_and_session_continues(chat_server):
+    url = chat_server.url
+    events = list(sse_stream(
+        url + "/chat", {"prompt_ids": P1, "max_new_tokens": 4, "stream": True},
+    ))
+    done = events[-1]
+    assert done.get("event") == "done" and done["turn"] == 1
+    streamed = [t for e in events[:-1] for t in e.get("token_ids", [])]
+    assert streamed == done["token_ids"]
+    # the streamed turn retained KV like a non-streamed one
+    r2 = _post(url, "/chat", {"session_id": done["session_id"],
+                              "prompt_ids": P2, "max_new_tokens": 4})
+    assert r2["retained_hit"]
+
+
+def test_stop_sequences_truncate_and_never_stream_past(chat_server):
+    url = chat_server.url
+    tok = chat_server.tokenizer
+    base = _post(url, "/generate", {"prompt_ids": P1, "max_new_tokens": 8})
+    text = tok.decode(base["token_ids"])
+    assert len(text) >= 3, "toy model must emit something"
+    stop = text[1:3]
+
+    out = _post(url, "/generate", {"prompt_ids": P1, "max_new_tokens": 8,
+                                   "stop": stop})
+    assert out["finish_reason"] == "stop"
+    assert stop not in tok.decode(out["token_ids"])
+    assert len(out["token_ids"]) < len(base["token_ids"])
+
+    # streaming: no emitted token may ever cross the match
+    events = list(sse_stream(
+        url + "/generate",
+        {"prompt_ids": P1, "max_new_tokens": 8, "stop": [stop], "stream": True},
+    ))
+    streamed = [t for e in events[:-1] for t in e.get("token_ids", [])]
+    assert streamed == out["token_ids"]
+    assert events[-1]["finish_reason"] == "stop"
+
+    # stop also applies on /chat
+    c = _post(url, "/chat", {"prompt_ids": P1, "max_new_tokens": 8,
+                             "stop": [stop]})
+    assert c["finish_reason"] == "stop"
+    assert stop not in tok.decode(c["token_ids"])
+
+
+def test_chat_rejections(chat_server):
+    url = chat_server.url
+    code, body = _error(url, "/chat", {"session_id": "missing",
+                                       "prompt_ids": P1})
+    assert code == 409 and body["session_reset"]
+    assert body["reason"] == "unknown_session"
+    # unknown payload keys stay a 400 (allowlist), same as /generate
+    code, _ = _error(url, "/chat", {"prompt_ids": P1, "temperature": 0.7})
+    assert code == 400
+    code, _ = _error(url, "/generate", {"prompt_ids": [1], "temperature": 0.5})
+    assert code == 400
+
+
+def test_chat_requires_sessions_enabled(trainer):
+    server = make_session_server(trainer, sessions=False)
+    url = server.start_background()
+    try:
+        code, body = _error(url, "/chat", {"prompt_ids": P1})
+        assert code == 400
+        # and /generate is untouched by the feature being off
+        out = _post(url, "/generate", {"prompt_ids": P1, "max_new_tokens": 4})
+        assert out["finish_reason"] in ("eos", "length")
+    finally:
+        server.shutdown()
+
+
+def test_weight_swap_resets_sessions_and_frees_pins(trainer):
+    """Satellite: no session pin may outlive a weight swap — the next
+    turn 409s (never stale KV), the pool accounting returns to zero
+    retained blocks, and the ChatSession client transparently replays."""
+    server = make_session_server(trainer, num_slots=2, max_new=4)
+    url = server.start_background()
+    try:
+        store = server.engine.session_store
+        r1 = _post(url, "/chat", {"prompt_ids": P1, "max_new_tokens": 4})
+        assert store.retained_blocks() >= 1
+
+        server.engine.set_params(trainer.params)
+        assert store.retained_blocks() == 0, "pins must not survive the swap"
+
+        code, body = _error(url, "/chat", {"session_id": r1["session_id"],
+                                           "prompt_ids": P2})
+        assert code == 409 and body["session_reset"]
+        assert body["reason"] == "weights_updated"
+
+        # client-side recovery: replay the transcript as a fresh session
+        cs = ChatSession(url)
+        o1 = cs.send(P1, max_new_tokens=4)
+        server.engine.set_params(trainer.params)
+        o2 = cs.send(P2, max_new_tokens=4)
+        assert cs.resets == 1
+        g = _post(url, "/generate",
+                  {"prompt_ids": P1 + o1["token_ids"] + P2, "max_new_tokens": 4})
+        assert o2["token_ids"] == g["token_ids"]
+    finally:
+        server.shutdown()
+
+
+def test_mid_conversation_block_eviction_reprefills(trainer):
+    """Block-pressure eviction drops a session's pins but not its
+    history: the following turn silently re-prefills the whole
+    conversation and the transcript stays bitwise identical."""
+    server = make_session_server(trainer, num_slots=2, max_new=4)
+    url = server.start_background()
+    try:
+        store = server.engine.session_store
+        r1 = _post(url, "/chat", {"prompt_ids": P1, "max_new_tokens": 4})
+        sid = r1["session_id"]
+        sess = store.get(sid)
+        assert sess.blocks
+
+        # force the block-pressure path: demand more than the free list
+        freed = store.evict_for_blocks(server.engine._block_pool.available() + 1)
+        assert freed >= 1 and sess.blocks == []
+
+        r2 = _post(url, "/chat", {"session_id": sid, "prompt_ids": P2,
+                                  "max_new_tokens": 4})
+        assert not r2["retained_hit"]  # re-prefill, not retained reuse
+        g = _post(url, "/generate",
+                  {"prompt_ids": P1 + r1["token_ids"] + P2, "max_new_tokens": 4})
+        assert g["token_ids"] == r2["token_ids"]
+        # and retention resumes: the next turn hits again
+        r3 = _post(url, "/chat", {"session_id": sid, "prompt_ids": P3,
+                                  "max_new_tokens": 4})
+        assert r3["retained_hit"]
+    finally:
+        server.shutdown()

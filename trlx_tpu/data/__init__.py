@@ -69,6 +69,11 @@ class PPORLElement:
     # to — rides the store so group-relative normalization happens per
     # prompt group, not per chunk (None for PPO)
     group_id: Optional[int] = None
+    # multi-turn rollouts: f32 [response_size] with 1.0 on policy-authored
+    # tokens and 0.0 on environment-authored ones (tool output, game
+    # state) — the loss and whitening only see policy tokens. None for
+    # single-turn rollouts (everything policy-authored).
+    loss_mask: Optional[np.ndarray] = None
 
 
 @flax.struct.dataclass
@@ -88,6 +93,9 @@ class PPORLBatch:
     h_split: Any = None
     # optional int32 [b] prompt-group ids (GRPO/RLOO); None for PPO
     group_ids: Any = None
+    # optional f32 [b, padded_response] policy-token masks (multi-turn
+    # rollouts); None (no pytree leaf) for single-turn training
+    loss_masks: Any = None
 
 
 # ---------------------------------------------------------------------------

@@ -252,6 +252,21 @@ class InferenceConfig:
         ``/debug/trace`` window).
     :param flight_recorder_events: per-component flight-recorder ring
         capacity (events retained for postmortem bundles).
+    :param sessions: multi-turn chat sessions (``POST /chat``): the
+        conversation's KV blocks stay pinned server-side between turns,
+        so every turn after the first prefills only its delta tokens.
+        Requires kv_paging; off (default) keeps serving bit-identical
+        and /chat answers 400.
+    :param session_ttl_s: idle sessions older than this are dropped by
+        the scheduler's sweep (their next turn answers HTTP 409
+        ``session_reset``).
+    :param session_max: resident-session cap; creating one past it
+        evicts the LRU idle session, and with every session busy the
+        create answers HTTP 503 + Retry-After.
+    :param session_bytes_budget_mb: cap on retained-KV bytes across all
+        sessions; past it, idle sessions lose their pins LRU-first (the
+        token history is kept, so the next turn transparently
+        re-prefills). 0 = bounded only by block-pool pressure.
     """
 
     num_slots: int = 8
@@ -284,6 +299,10 @@ class InferenceConfig:
     trace_sample_rate: float = 0.0
     trace_ring: int = 256
     flight_recorder_events: int = 512
+    sessions: bool = False
+    session_ttl_s: float = 600.0
+    session_max: int = 256
+    session_bytes_budget_mb: float = 0.0
 
     @classmethod
     def from_dict(cls, config: Dict[str, Any]):

@@ -17,7 +17,11 @@
 - `server` — HTTP `POST /generate` + `/healthz` (liveness/readiness) +
   Prometheus `/metrics` + `POST /admin/{drain,undrain,reload}`,
   drain-on-sync checkpoint hot-reload, SIGTERM drain-then-exit;
-- `client` — `remote_generate` on the shared retry/circuit-breaker stack;
+- `sessions` — `SessionStore`: multi-turn chat sessions whose KV blocks
+  stay pinned between turns (follow-up turns prefill only their delta),
+  TTL/LRU/byte-budget eviction, weight-update invalidation;
+- `client` — `remote_generate` / `stream_generate` / `ChatSession` on the
+  shared retry/circuit-breaker stack;
 - `fleet` — `ReplicaRouter` fronting N replicas: health probes, per-replica
   circuit breakers, least-loaded dispatch with failover, hedged requests,
   bounded-staleness weight sync, whole-fleet-down degradation signal;
@@ -33,7 +37,12 @@ from trlx_tpu.inference.adapters import (
     AdapterStore,
     adapter_salt,
 )
-from trlx_tpu.inference.client import remote_generate
+from trlx_tpu.inference.client import (
+    ChatSession,
+    remote_generate,
+    sse_stream,
+    stream_generate,
+)
 from trlx_tpu.inference.engine import InferenceEngine
 from trlx_tpu.inference.fleet import FleetUnavailableError, Replica, ReplicaRouter
 from trlx_tpu.inference.metrics import InferenceMetrics
@@ -49,6 +58,12 @@ from trlx_tpu.inference.server import (
     InferenceServer,
     load_checkpoint_params,
 )
+from trlx_tpu.inference.sessions import (
+    SessionBusyError,
+    SessionLimitError,
+    SessionResetError,
+    SessionStore,
+)
 from trlx_tpu.inference.supervisor import (
     FleetSupervisor,
     ReplicaHandle,
@@ -62,6 +77,7 @@ __all__ = [
     "AdapterNotFoundError",
     "AdapterStore",
     "BlockPool",
+    "ChatSession",
     "CheckpointWatcher",
     "DrainingError",
     "FleetSupervisor",
@@ -76,10 +92,16 @@ __all__ = [
     "ReplicaHandle",
     "ReplicaRouter",
     "Scheduler",
+    "SessionBusyError",
+    "SessionLimitError",
+    "SessionResetError",
+    "SessionStore",
     "SubprocessReplica",
     "ThreadReplica",
     "adapter_salt",
     "load_checkpoint_params",
     "prefix_keys",
     "remote_generate",
+    "sse_stream",
+    "stream_generate",
 ]

@@ -216,10 +216,15 @@ class InferenceEngine:
             )
             self._slot_blocks: Dict[int, List[int]] = {}
             # BlockPool is plain Python touched by the driver thread
-            # (insert/reclaim) AND the hot-reload thread (flush_cached)
-            self._kv_lock = threading.Lock()
+            # (insert/reclaim) AND the hot-reload thread (flush_cached).
+            # Re-entrant: the session store shares this lock and the
+            # insert path calls back into it while already holding it
+            # (evict-under-pressure, retained-block acquisition).
+            self._kv_lock = threading.RLock()
         else:
             self._block_pool = None
+        # multi-turn chat: retained-block registry (enable_sessions)
+        self.session_store = None
 
         # scheduler-owned trace buffer: while a traced batch inserts, the
         # scheduler sets this to a list and the insert path appends
@@ -315,6 +320,11 @@ class InferenceEngine:
             # silently mix old-prefix K/V with new-weight decode
             with self._kv_lock:
                 self._block_pool.flush_cached()
+        if self.session_store is not None:
+            # same staleness contract for session-retained blocks: pins
+            # release now, every session answers its next turn with a
+            # 409 session_reset instead of silently serving old KV
+            self.session_store.invalidate_all("weights_updated")
         with self._param_lock:
             self._params = params
             self._spec_head = head
@@ -527,6 +537,7 @@ class InferenceEngine:
         self,
         rows: Sequence[Tuple],  # (unpadded prompt ids, max_new[, adapter_id])
         slot_ids: Sequence[int],
+        sessions: Optional[Sequence] = None,  # per-row Session or None
     ) -> None:
         """Prefill `rows` (length-bucketed, left-padded) and scatter them
         into the given free slots. Requests are grouped by prompt-width
@@ -535,15 +546,23 @@ class InferenceEngine:
         right-padded suffix prefill). Multi-tenant rows carry an adapter
         id as a third element; the engine pins each row's adapter in the
         store for the request's lifetime (released in `reclaim_slots`)
-        and the prefill program applies per-row factors."""
+        and the prefill program applies per-row factors. `sessions`
+        (paged only) attaches a row to a chat session: its retained
+        blocks seed the shared prefix, so only the conversation's delta
+        tokens prefill."""
         assert len(rows) == len(slot_ids)
+        if sessions is not None and any(s is not None for s in sessions):
+            if not self.kv_paging:
+                raise ValueError("sessions require kv_paging")
+        else:
+            sessions = None
         norm = [self._split_row(r) for r in rows]
         aslots: Optional[List[int]] = None
         if self.multi_tenant:
             aslots = self._acquire_adapters(norm, slot_ids)
         try:
             if self.kv_paging:
-                self._insert_paged(norm, slot_ids, aslots)
+                self._insert_paged(norm, slot_ids, aslots, sessions)
             else:
                 self._insert_dense(norm, slot_ids, aslots)
         except Exception:
@@ -654,7 +673,22 @@ class InferenceEngine:
             )
         return ids
 
-    def _insert_paged(self, rows, slot_ids, aslots: Optional[List[int]] = None) -> None:
+    def _alloc_evicting_sessions(self, n: int) -> List[int]:
+        """pool.alloc with one retry after un-pinning idle sessions'
+        retained blocks LRU-first (block pressure evicts conversations'
+        KV before refusing new work). Lock already held (re-entrant)."""
+        try:
+            return self._block_pool.alloc(n)
+        except KVPoolExhaustedError:
+            if self.session_store is None:
+                raise
+            self.session_store.evict_for_blocks(n)
+            return self._block_pool.alloc(n)
+
+    def _insert_paged(
+        self, rows, slot_ids, aslots: Optional[List[int]] = None,
+        sessions: Optional[Sequence] = None,
+    ) -> None:
         """Paged insert: allocate each request's blocks up front
         (prompt + max_new + spec_k — no mid-decode OOM, no preemption),
         probing the prefix store for resident leading blocks first. In
@@ -667,15 +701,23 @@ class InferenceEngine:
         its blocks would read zeros. Each round places at least the first
         pending request, so this terminates; GRPO's n-way fan-out of one
         prompt resolves as 1 full prefill + (n-1) suffix prefills batched
-        together in round two."""
+        together in round two.
+
+        Session rows bypass the prefix store entirely: their shared
+        prefix is the conversation's own retained block chain (taken via
+        per-request references, so the normal slot-reclaim release works
+        unchanged) and their blocks are never published under keys —
+        retained KV stays private to its conversation."""
         bs, pool = self.kv_block_size, self._block_pool
         mt = self.multi_tenant
-        pending: List[Tuple[np.ndarray, int, int, bytes, int]] = []
+        store = self.session_store
+        pending: List[Tuple] = []
         for i, ((ids, max_new, name), slot) in enumerate(zip(rows, slot_ids)):
             salt = adapter_salt(name) if mt else b""
             pending.append((
                 self._check_row(ids, max_new), int(max_new), int(slot),
                 salt, aslots[i] if mt else 0,
+                sessions[i] if sessions is not None else None,
             ))
         params = self._current_params()
         # place every round before dispatching anything, journalling each
@@ -690,25 +732,34 @@ class InferenceEngine:
                 while pending:
                     placed, deferred = [], []
                     round_keys: set = set()
-                    for ids, max_new, slot, salt, aslot in pending:
-                        keys = prefix_keys(ids, bs, salt) if self.prefix_cache else []
-                        if any(k in round_keys for k in keys):
-                            deferred.append((ids, max_new, slot, salt, aslot))
-                            continue
-                        shared: List[int] = []
-                        for key in keys:
-                            blk = pool.acquire_cached(key)
-                            if blk is None:
-                                break
-                            shared.append(blk)
-                        if keys:
+                    for ids, max_new, slot, salt, aslot, sess in pending:
+                        if sess is not None:
+                            keys = []
+                            shared = store.acquire_blocks(sess, ids)
+                            sess.last_reused_blocks = len(shared)
+                            sess.last_prefill_tokens = ids.size - len(shared) * bs
                             if shared:
-                                pool.hits += 1
-                            else:
-                                pool.misses += 1
+                                store.retained_hits += 1
+                                store.retained_blocks_reused += len(shared)
+                        else:
+                            keys = prefix_keys(ids, bs, salt) if self.prefix_cache else []
+                            if any(k in round_keys for k in keys):
+                                deferred.append((ids, max_new, slot, salt, aslot, sess))
+                                continue
+                            shared = []
+                            for key in keys:
+                                blk = pool.acquire_cached(key)
+                                if blk is None:
+                                    break
+                                shared.append(blk)
+                            if keys:
+                                if shared:
+                                    pool.hits += 1
+                                else:
+                                    pool.misses += 1
                         n_cap = -(-(ids.size + max_new + self.spec_k) // bs)
                         try:
-                            owned = pool.alloc(n_cap - len(shared))
+                            owned = self._alloc_evicting_sessions(n_cap - len(shared))
                         except KVPoolExhaustedError:
                             pool.release(shared)
                             raise
@@ -1101,26 +1152,49 @@ class InferenceEngine:
 
     def projected_blocks(
         self, prompt_ids, max_new_tokens: int, ignore_cache: bool = False,
-        adapter_id: Optional[str] = None,
+        adapter_id: Optional[str] = None, session=None,
     ) -> int:
         """Blocks this request would claim if admitted now:
         ceil((prompt + max_new + spec_k) / block_size) minus the leading
         blocks a read-only prefix-store probe says are resident (probed
-        in the request's own adapter key space). 0 when paging is off."""
+        in the request's own adapter key space), or minus the session's
+        retained blocks when the request rides one. 0 when paging is
+        off."""
         if not self.kv_paging:
             return 0
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        n_cap = -(-(ids.size + int(max_new_tokens) + self.spec_k) // self.kv_block_size)
+        if session is not None:
+            # session rows never touch the prefix store; their only
+            # reuse is the conversation's own retained prefix
+            if ignore_cache:
+                return max(1, n_cap)
+            with self._kv_lock:
+                cov = session.covered_tokens(self.kv_block_size)
+                shared = (
+                    len(session.blocks)
+                    if session.reset_reason is None
+                    and ids.size > cov
+                    and np.array_equal(ids[:cov], session.tokens[:cov])
+                    else 0
+                )
+            return max(1, n_cap - shared)
         salt = adapter_salt(adapter_id) if self.multi_tenant else b""
         with self._kv_lock:
             shared = 0 if ignore_cache else self._block_pool.lookup_chain(ids, salt)
-        n_cap = -(-(ids.size + int(max_new_tokens) + self.spec_k) // self.kv_block_size)
         return max(1, n_cap - shared)
 
     def blocks_available(self) -> int:
+        """Blocks a new request can claim: free + evictable idle
+        (prefix-cache idle blocks, plus idle sessions' retained pins —
+        the insert path evicts those under pressure)."""
         if not self.kv_paging:
             return 0
         with self._kv_lock:
-            return self._block_pool.available()
+            n = self._block_pool.available()
+            if self.session_store is not None:
+                n += self.session_store.evictable_blocks()
+            return n
 
     @property
     def total_blocks(self) -> int:
@@ -1157,13 +1231,60 @@ class InferenceEngine:
             }
 
     # ------------------------------------------------------------------
+    # Sessions (multi-turn chat: retained KV between requests)
+    # ------------------------------------------------------------------
+
+    def enable_sessions(
+        self,
+        ttl_s: float = 600.0,
+        max_sessions: int = 256,
+        bytes_budget_mb: float = 0.0,
+    ):
+        """Attach a `SessionStore` sharing this engine's block pool and
+        KV lock. Requires kv_paging (retention IS block pinning).
+        Returns the store (also kept as `self.session_store`)."""
+        from trlx_tpu.inference.sessions import SessionStore
+
+        if not self.kv_paging:
+            raise ValueError("sessions require kv_paging (retained KV blocks)")
+        block_bytes = self.kv_stats()["kv_pool_bytes"] // self._n_blocks
+        self.session_store = SessionStore(
+            self._block_pool, self.kv_block_size, lock=self._kv_lock,
+            ttl_s=ttl_s, max_sessions=max_sessions,
+            bytes_budget=int(bytes_budget_mb * 1024 * 1024),
+            block_bytes=block_bytes,
+        )
+        return self.session_store
+
+    def retain_session(self, slot: int, session, full_ids) -> int:
+        """Pin a finishing turn's leading blocks into its session.
+        Driver thread only, BEFORE `reclaim_slots` — the slot's blocks
+        must still hold the request's references. Returns the retained
+        block count."""
+        if not self.kv_paging or self.session_store is None:
+            return 0
+        with self._kv_lock:
+            blocks = self._slot_blocks.get(int(slot))
+            if not blocks:
+                return 0
+            return self.session_store.retain_turn(session, blocks, full_ids)
+
+    def session_stats(self) -> Dict[str, float]:
+        """Session-store counters for metrics/healthz; {} when off."""
+        return self.session_store.stats() if self.session_store is not None else {}
+
+    # ------------------------------------------------------------------
     # Multi-tenant adapter plumbing
     # ------------------------------------------------------------------
 
     def flush_adapter_prefixes(self, name: Optional[str]) -> int:
         """Drop one adapter's cached prefix blocks (per-adapter
         hot-reload: its K/V went stale, everyone else's is still good).
-        Returns the number of keys flushed; 0 when prefix caching is off."""
+        Returns the number of keys flushed; 0 when prefix caching is off.
+        The adapter's sessions reset for the same reason — their retained
+        KV was written under the replaced adapter weights."""
+        if self.session_store is not None:
+            self.session_store.invalidate_adapter(name)
         if not self.prefix_cache:
             return 0
         with self._kv_lock:

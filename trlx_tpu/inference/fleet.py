@@ -90,6 +90,20 @@ class Replica:
             error_label=f"replica {self.url}",
             _sleep=_sleep,
         )
+        self.chat_client = RetryingJSONClient(
+            self.url + "/chat",
+            timeout=timeout,
+            retries=retries,
+            retry_base_delay=retry_base_delay,
+            retry_max_delay=retry_max_delay,
+            breaker_threshold=breaker_threshold,
+            breaker_recovery=breaker_recovery,
+            error_label=f"replica {self.url}",
+            _sleep=_sleep,
+        )
+        # one breaker per replica, not per endpoint: /chat failures and
+        # /generate failures are the same replica dying
+        self.chat_client.breaker = self.client.breaker
         # optimistic until the first probe says otherwise: a router built
         # before its replicas finish binding should not blacklist them
         self.live = True
@@ -206,7 +220,15 @@ class ReplicaRouter:
             "hedges_cancelled": 0,
             "hedges_wasted": 0,
             "stale_rejected": 0,
+            "session_turns": 0,
+            "session_failovers": 0,
+            "session_resets": 0,
         }
+        # session affinity: caller key -> (replica url, server session
+        # id, full id transcript). The transcript is the recovery path —
+        # a failover or 409 session_reset replays the whole conversation
+        # as a fresh session on another (or the same) replica.
+        self._sessions: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.Lock()
         self._latencies: deque = deque(maxlen=256)
         n = max(int(concurrency), 1)
@@ -523,6 +545,123 @@ class ReplicaRouter:
                 f"first: {errors[0]}"
             )
         return results
+
+    # ------------------------------------------------------------------
+    # Multi-turn sessions (sticky routing + transcript recovery)
+    # ------------------------------------------------------------------
+
+    def _chat_post(self, rep: Replica, payload: Dict) -> Dict:
+        """`_post` against the replica's /chat endpoint (same inflight /
+        latency / breaker bookkeeping)."""
+        with self._lock:
+            rep.inflight += 1
+        t0 = time.monotonic()
+        try:
+            out = rep.chat_client.post(dict(payload))
+        except Exception as e:
+            with self._lock:
+                rep.inflight -= 1
+                rep.failures += 1
+                rep.last_error = str(e)
+            raise
+        dt = time.monotonic() - t0
+        with self._lock:
+            rep.inflight -= 1
+            rep.served += 1
+            self._latencies.append(dt)
+        return out
+
+    def _chat_fresh(self, ids: List[int], **kwargs) -> (
+        "tuple[Replica, Dict]"
+    ):
+        """Create a brand-new server session for the full transcript
+        `ids`, with generate-style failover across eligible replicas."""
+        payload = dict(kwargs)
+        payload["prompt_ids"] = list(map(int, ids))
+        adapter_id = payload.get("adapter_id")
+        tried: List[Replica] = []
+        reprobed = False
+        last_exc: Optional[BaseException] = None
+        while True:
+            rep = self._pick(exclude=tried, adapter_id=adapter_id)
+            if rep is None and not reprobed:
+                reprobed = True
+                if self.probe_all(force=True):
+                    rep = self._pick(exclude=tried, adapter_id=adapter_id)
+            if rep is None:
+                raise FleetUnavailableError(
+                    f"no eligible replica for chat (tried "
+                    f"{[r.url for r in tried] or 'none'}; last error: {last_exc})"
+                )
+            tried.append(rep)
+            try:
+                return rep, self._chat_post(rep, payload)
+            except (resilience.TransientError, resilience.CircuitOpenError) as e:
+                last_exc = e
+                with self._lock:
+                    self.counters["failovers"] += 1
+
+    def chat(self, turn_ids: List[int], session_key: str, **kwargs) -> Dict:
+        """One conversation turn with session affinity.
+
+        `session_key` is the caller's conversation id (e.g. one rollout's
+        environment episode). Turns for the same key stick to the replica
+        holding the session's retained KV; the router keeps the full id
+        transcript, so a replica failure, a 409 `session_reset` (TTL,
+        eviction, weight swap), or a removed replica is recovered by
+        replaying the conversation as a fresh session — possibly
+        elsewhere. Turns are token ids only: a text turn could not be
+        replayed without a tokenizer. Reply dicts are the server's /chat
+        schema (`retained_hit`, `prefill_tokens`, `ttft_s`, ...)."""
+        turn_ids = list(map(int, turn_ids))
+        with self._lock:
+            self.counters["requests"] += 1
+            self.counters["session_turns"] += 1
+            entry = self._sessions.get(session_key)
+        self.probe_all()
+        out = None
+        rep = None
+        if entry is not None:
+            try:
+                rep = self._by_url(entry["url"])
+            except KeyError:
+                rep = None  # replica removed from the fleet
+            if rep is not None and self._eligible(rep):
+                payload = dict(kwargs)
+                payload["session_id"] = entry["session_id"]
+                payload["prompt_ids"] = turn_ids
+                try:
+                    out = self._chat_post(rep, payload)
+                except (resilience.TransientError, resilience.CircuitOpenError):
+                    with self._lock:
+                        self.counters["session_failovers"] += 1
+                    out = None
+                except RuntimeError as e:
+                    # 409 session_reset (or unknown id after a replica
+                    # respawn): replay below. Anything else — including
+                    # 409 session_busy — is a caller error and surfaces.
+                    if "reset" not in str(e):
+                        raise
+                    with self._lock:
+                        self.counters["session_resets"] += 1
+                    out = None
+        if out is None:
+            full = (entry["ids"] if entry is not None else []) + turn_ids
+            rep, out = self._chat_fresh(full, **kwargs)
+        with self._lock:
+            self._sessions[session_key] = {
+                "url": rep.url,
+                "session_id": out["session_id"],
+                "ids": (entry["ids"] if entry is not None else [])
+                + turn_ids + list(map(int, out.get("token_ids", []))),
+            }
+        return out
+
+    def end_session(self, session_key: str) -> None:
+        """Forget a conversation's affinity + transcript (the server side
+        expires on its own TTL)."""
+        with self._lock:
+            self._sessions.pop(session_key, None)
 
     # ------------------------------------------------------------------
     # Drain (weight-sync coordination) + introspection

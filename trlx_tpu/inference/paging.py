@@ -157,6 +157,20 @@ class BlockPool:
             out.append(block)
         return out
 
+    def retain(self, blocks) -> None:
+        """Take one extra reference per block on already-live blocks
+        (session retention: a conversation pins its leading blocks between
+        turns so they survive the owning request's release). Blocks must
+        currently hold at least one reference — retaining a freed or idle
+        block would resurrect recycled storage."""
+        for block in blocks:
+            ref = self._ref.get(block, 0)
+            if ref <= 0:
+                raise ValueError(
+                    f"retain on block {block} with no live reference"
+                )
+            self._ref[block] = ref + 1
+
     def register(self, key: bytes, block: int) -> None:
         """Publish a block (just prefilled by its owner) under a prefix
         key. First writer wins — duplicate keys keep the original block so

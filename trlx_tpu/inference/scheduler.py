@@ -76,6 +76,20 @@ class InferenceRequest:
     adapter_id: Optional[str] = None  # multi-tenant: None = base policy
     # server/router-assigned id (echoed in every reply and error body)
     request_id: Optional[str] = None
+    # per-request stop strings: generation halts with finish_reason
+    # "stop" when the decoded response contains one (token-granular
+    # truncation to the largest prefix containing no stop)
+    stop_sequences: Optional[List[str]] = None
+    # chat session this request extends (paged engines only): its
+    # retained blocks seed the prefill, and the finishing turn's leading
+    # blocks are pinned back into it
+    session: Optional[object] = field(default=None, repr=False)
+    # incremental token sink (server streaming): the driver thread puts
+    # {"token_ids": [...]} deltas as tokens clear the stop holdback, and
+    # None as the done sentinel after the finish fields are set
+    stream: Optional[object] = field(default=None, repr=False)
+    # tokens already pushed to `stream`
+    streamed: int = 0
     # admission pipeline position — constant interned strings, maintained
     # even with tracing off so a 504 can always say which stage the
     # request died in: queued -> admitted -> prefill -> decode
@@ -83,17 +97,25 @@ class InferenceRequest:
     # live RequestTrace when inference.tracing is on (None otherwise)
     trace: Optional[object] = field(default=None, repr=False)
     enqueue_time: float = field(default_factory=time.monotonic)
+    # first emitted token's wall time (TTFT = this - enqueue_time)
+    first_token_time: Optional[float] = None
     token_ids: List[int] = field(default_factory=list)
     # per-token policy logprobs (raw-logit log-softmax at each emitted
     # token), filled alongside token_ids by the fused decode step
     token_logprobs: List[float] = field(default_factory=list)
-    finish_reason: Optional[str] = None  # eos | length | deadline | shutdown
+    finish_reason: Optional[str] = None  # eos | length | stop | deadline | shutdown
     finish_time: Optional[float] = None
     _done: threading.Event = field(default_factory=threading.Event, repr=False)
 
     @property
     def ok(self) -> bool:
-        return self.finish_reason in ("eos", "length")
+        return self.finish_reason in ("eos", "length", "stop")
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.enqueue_time
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -120,8 +142,13 @@ class Scheduler:
         tenant_queue_depth: int = 0,
         tracer=None,
         recorder=None,
+        detokenize=None,
     ):
         self.engine = engine
+        # token-ids -> text (the server passes its tokenizer's decode);
+        # needed for stop-sequence matching and the streaming holdback —
+        # without it, stop_sequences on submit are rejected
+        self.detokenize = detokenize
         # observability (both None unless inference.tracing is on; every
         # use is guarded so the flag-off hot path allocates nothing)
         self.tracer = tracer
@@ -158,6 +185,7 @@ class Scheduler:
         # EWMA of decode-step wall time, feeding Retry-After predictions
         self._decode_ewma = 0.0
         self._slots_active_peak = 0
+        self._last_session_sweep = 0.0
 
     # ------------------------------------------------------------------
     # Client surface (any thread)
@@ -169,7 +197,16 @@ class Scheduler:
         return name if name else "base"
 
     def _validate(self, prompt_ids, max_new_tokens: Optional[int],
-                  adapter_id: Optional[str] = None):
+                  adapter_id: Optional[str] = None,
+                  stop_sequences: Optional[List[str]] = None):
+        if stop_sequences:
+            if self.detokenize is None:
+                raise ValueError(
+                    "stop sequences need a scheduler built with a "
+                    "detokenize callable (the server wires its tokenizer)"
+                )
+            if not all(isinstance(s, str) and s for s in stop_sequences):
+                raise ValueError("stop sequences must be non-empty strings")
         if adapter_id is not None:
             if not getattr(self.engine, "multi_tenant", False):
                 raise ValueError(
@@ -261,8 +298,15 @@ class Scheduler:
         adapter_id: Optional[str] = None,
         request_id: Optional[str] = None,
         trace=None,
+        stop_sequences: Optional[List[str]] = None,
+        session=None,
+        stream=None,
     ) -> InferenceRequest:
-        ids, max_new = self._validate(prompt_ids, max_new_tokens, adapter_id)
+        ids, max_new = self._validate(
+            prompt_ids, max_new_tokens, adapter_id, stop_sequences
+        )
+        if session is not None and not getattr(self.engine, "kv_paging", False):
+            raise ValueError("sessions require a paged engine (kv_paging)")
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         req = InferenceRequest(
             id=next(self._ids),
@@ -272,6 +316,9 @@ class Scheduler:
             adapter_id=adapter_id,
             request_id=request_id,
             trace=trace,
+            stop_sequences=list(stop_sequences) if stop_sequences else None,
+            session=session,
+            stream=stream,
         )
         self._enqueue([req])
         return req
@@ -285,6 +332,7 @@ class Scheduler:
         adapter_id: Optional[str] = None,
         request_id: Optional[str] = None,
         traces: Optional[List] = None,
+        stop_sequences: Optional[List[str]] = None,
     ) -> List[InferenceRequest]:
         """GRPO-style fan-out: enqueue `n` independent generations of one
         prompt as ADJACENT queue entries under one lock, so the paged
@@ -293,7 +341,9 @@ class Scheduler:
         the prompt's KV blocks. All-or-nothing against queue depth."""
         if n < 1:
             raise ValueError(f"n must be >= 1, got {n}")
-        ids, max_new = self._validate(prompt_ids, max_new_tokens, adapter_id)
+        ids, max_new = self._validate(
+            prompt_ids, max_new_tokens, adapter_id, stop_sequences
+        )
         dl = deadline_s if deadline_s is not None else self.default_deadline_s
         deadline = (time.monotonic() + dl) if dl else None
         reqs = [
@@ -305,6 +355,7 @@ class Scheduler:
                 adapter_id=adapter_id,
                 request_id=request_id,
                 trace=(traces[i] if traces else None),
+                stop_sequences=list(stop_sequences) if stop_sequences else None,
             )
             for i in range(n)
         ]
@@ -439,9 +490,14 @@ class Scheduler:
             leftovers = list(self._queue) + list(self._slot_req.values())
             self._queue.clear()
         self.engine.release_slots(list(self._slot_req))
+        store = getattr(self.engine, "session_store", None)
         for req in leftovers:
             req.finish_reason = "shutdown"
             req.finish_time = time.monotonic()
+            if req.stream is not None:
+                req.stream.put(None)
+            if req.session is not None and store is not None:
+                store.end_turn(req.session)
             if req.trace is not None:
                 req.trace.attrs["finish_reason"] = "shutdown"
                 req.trace.attrs["stage"] = req.stage
@@ -459,6 +515,12 @@ class Scheduler:
 
     def _loop(self) -> None:
         while True:
+            store = getattr(self.engine, "session_store", None)
+            if store is not None:
+                now = time.monotonic()
+                if now - self._last_session_sweep > 1.0:
+                    self._last_session_sweep = now
+                    store.sweep(now)
             with self._cond:
                 if not self._running:
                     return
@@ -540,9 +602,12 @@ class Scheduler:
             req = next(r for r in self._queue if self._tenant(r) == pick)
             if paged:
                 need = self.engine.projected_blocks(
-                    req.prompt_ids, req.max_new_tokens, adapter_id=req.adapter_id
+                    req.prompt_ids, req.max_new_tokens,
+                    adapter_id=req.adapter_id, session=req.session,
                 ) if getattr(self.engine, "multi_tenant", False) else (
-                    self.engine.projected_blocks(req.prompt_ids, req.max_new_tokens)
+                    self.engine.projected_blocks(
+                        req.prompt_ids, req.max_new_tokens, session=req.session
+                    )
                 )
                 if need > budget:
                     skipped.add(pick)  # this tenant waits; others may still fit
@@ -582,7 +647,8 @@ class Scheduler:
                     if paged:
                         head = self._queue[0]
                         need = self.engine.projected_blocks(
-                            head.prompt_ids, head.max_new_tokens
+                            head.prompt_ids, head.max_new_tokens,
+                            session=head.session,
                         )
                         if need > budget:
                             break  # FIFO head waits until decodes free blocks
@@ -647,9 +713,13 @@ class Scheduler:
                 if multi_tenant
                 else [(r.prompt_ids, r.max_new_tokens) for r in batch]
             )
+            sessions = (
+                [r.session for r in batch]
+                if any(r.session is not None for r in batch) else None
+            )
             t0 = time.perf_counter()
             try:
-                self.engine.insert_requests(rows, slots)
+                self.engine.insert_requests(rows, slots, sessions=sessions)
                 break
             except AdapterCapacityError:
                 # the batch needs more distinct adapters pinned at once
@@ -747,6 +817,9 @@ class Scheduler:
                     req.token_logprobs.append(float(logprobs[slot, j]))
                     n_slot += 1
             emitted += n_slot
+            if n_slot and req.first_token_time is None:
+                req.first_token_time = now
+                self.metrics.observe("ttft_seconds", req.first_token_time - req.enqueue_time)
             if multi_tenant and n_slot:
                 t = self._tenant(req)
                 tenant_emitted[t] = tenant_emitted.get(t, 0) + n_slot
@@ -755,9 +828,21 @@ class Scheduler:
                 # + accepted drafts) — the serving-side mirror of the
                 # trainer's rollout/spec_accept_rate
                 self.metrics.observe("spec_accepted_tokens", n_slot)
-            if finished[slot]:
+            stopped = bool(n_slot) and self._apply_stop(req)
+            if stopped:
+                # a stop sequence matched: truncated, session retained,
+                # slot cancelled (release_slots deactivates + reclaims)
+                self._retain_session(slot, req)
+                self.engine.release_slots([slot])
+                self._release(slot)
+                self._finish_request(req, "stop")
+            elif finished[slot]:
                 last = req.token_ids[-1] if req.token_ids else -1
                 reason = "eos" if last == eos else "length"
+                # retention must run BEFORE reclaim frees the slot's
+                # blocks — the session's new pins piggyback on the
+                # request's still-live references
+                self._retain_session(slot, req)
                 self.engine.reclaim_slots([slot])
                 self._release(slot)
                 self._finish_request(req, reason)
@@ -765,6 +850,8 @@ class Scheduler:
                 self.engine.release_slots([slot])
                 self._release(slot)
                 self._finish_request(req, "deadline")
+            elif n_slot:
+                self._stream_emit(req)
         self.metrics.add("tokens_generated_total", emitted)
         for t, n in tenant_emitted.items():
             self.metrics.add(
@@ -779,6 +866,70 @@ class Scheduler:
                 ).end(m0 + dt)
             )
         self._sync_kv_metrics()
+
+    # ------------------------------------------------------------------
+    # Stop sequences / streaming / session retention
+    # ------------------------------------------------------------------
+
+    def _hits_stop(self, token_ids, stops) -> bool:
+        text = self.detokenize(token_ids)
+        return any(s in text for s in stops)
+
+    def _apply_stop(self, req: InferenceRequest) -> bool:
+        """Host-side stop-sequence scan over the decoded response text.
+        Token boundaries need not align with the stop string, so matching
+        happens on text: if any stop appears, the response is truncated to
+        the longest token prefix whose decoding contains no stop. Returns
+        True when the request should finish with reason "stop"."""
+        if not req.stop_sequences or not req.token_ids:
+            return False
+        if self._hits_stop(req.token_ids, req.stop_sequences):
+            k = len(req.token_ids)
+            while k and self._hits_stop(req.token_ids[:k], req.stop_sequences):
+                k -= 1
+            del req.token_ids[k:]
+            del req.token_logprobs[k:]
+            # streaming holdback guarantees streamed <= k; clamp anyway
+            req.streamed = min(req.streamed, k)
+            return True
+        return False
+
+    def _stream_emit(self, req: InferenceRequest, final: bool = False) -> None:
+        """Push newly decoded tokens to the request's stream queue. With
+        stop sequences active, hold back the last `max_stop_len - 1` chars
+        worth of tokens — a stop match can straddle the boundary between
+        already-emitted and pending text, and emitted tokens can never be
+        recalled. The final flush (post stop-scan) emits everything."""
+        if req.stream is None:
+            return
+        n = len(req.token_ids)
+        if not final and req.stop_sequences and n:
+            text = self.detokenize(req.token_ids)
+            max_stop = max(len(s) for s in req.stop_sequences)
+            safe_chars = len(text) - (max_stop - 1)
+            k = req.streamed
+            while (
+                k < n
+                and len(self.detokenize(req.token_ids[: k + 1])) <= safe_chars
+            ):
+                k += 1
+            n = k
+        if n > req.streamed:
+            req.stream.put({"token_ids": list(req.token_ids[req.streamed:n])})
+            req.streamed = n
+
+    def _retain_session(self, slot: int, req: InferenceRequest) -> None:
+        """Pin the conversation's leading full blocks in the block pool
+        before the slot's references are dropped, so turn N+1 prefills
+        only its delta tokens. Only runs on ok finishes — a failed turn
+        leaves the session at its previous turn's state for a clean
+        retry."""
+        if req.session is None:
+            return
+        full_ids = np.concatenate(
+            [req.prompt_ids, np.asarray(req.token_ids, np.int32)]
+        )
+        self.engine.retain_session(slot, req.session, full_ids)
 
     def _sync_kv_metrics(self) -> None:
         """Mirror the engine's block-pool tallies into the Prometheus
@@ -805,6 +956,21 @@ class Scheduler:
             "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
         ):
             self.metrics.set_counter(name, stats[name])
+        sstore = getattr(self.engine, "session_store", None)
+        if sstore is not None:
+            sstats = sstore.stats()
+            for name in (
+                "sessions_active", "sessions_max",
+                "session_retained_blocks", "session_retained_bytes",
+            ):
+                self.metrics.set_gauge(name, sstats[name])
+            for name in (
+                "session_created_total", "session_retained_hits_total",
+                "session_retained_blocks_reused_total",
+                "session_evictions_ttl_total", "session_evictions_lru_total",
+                "session_evictions_blocks_total", "session_resets_total",
+            ):
+                self.metrics.set_counter(name, sstats[name])
 
     def _release(self, slot: int) -> None:
         with self._cond:
@@ -815,12 +981,21 @@ class Scheduler:
     def _finish_request(self, req: InferenceRequest, reason: str) -> None:
         req.finish_reason = reason
         req.finish_time = time.monotonic()
+        if req.stream is not None:
+            # flush anything held back, then the done sentinel — finish
+            # fields are set, so the reader can collect summary state
+            self._stream_emit(req, final=True)
+            req.stream.put(None)
+        if req.session is not None:
+            store = getattr(self.engine, "session_store", None)
+            if store is not None:
+                store.end_turn(req.session)
         if req.trace is not None:
             t_dec = req.trace.marks.get("decode_start")
             if t_dec is not None:
                 req.trace.add(
                     "decode", t_dec, req.finish_time,
-                    status=("ok" if reason in ("eos", "length") else reason),
+                    status=("ok" if reason in ("eos", "length", "stop") else reason),
                     tokens=len(req.token_ids),
                 )
             elif req.stage == "queued":

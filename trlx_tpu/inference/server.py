@@ -44,17 +44,23 @@ continue on the new weights at their next decode step.
 import ast
 import json
 import os
+import queue
 import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from trlx_tpu import resilience
 from trlx_tpu.inference.adapters import AdapterError
 from trlx_tpu.inference.scheduler import DrainingError, QueueFullError, Scheduler
+from trlx_tpu.inference.sessions import (
+    SessionBusyError,
+    SessionLimitError,
+    SessionResetError,
+)
 from trlx_tpu.observability.tracing import new_id
 from trlx_tpu.utils import logging
 
@@ -252,6 +258,9 @@ class InferenceServer:
         # opens traces at ingress, the scheduler closes them at finish
         self.tracer = tracer if tracer is not None else getattr(scheduler, "tracer", None)
         self.tokenizer = tokenizer
+        if tokenizer is not None and getattr(scheduler, "detokenize", None) is None:
+            # stop-sequence scanning and /chat text replies need id->text
+            scheduler.detokenize = lambda ids: tokenizer.decode(list(ids))
         self.host = host
         self.port = port
         self.fault_injector = fault_injector
@@ -295,21 +304,37 @@ class InferenceServer:
 
     # ------------------------------------------------------------------
 
-    def _handle_generate(self, payload: Dict,
-                         request_id: Optional[str] = None) -> Dict:
+    def _encode_prompt(self, payload: Dict, truncate: bool = True) -> np.ndarray:
         if "prompt_ids" in payload:
-            ids = np.asarray(payload["prompt_ids"], np.int32).reshape(-1)
-        elif "prompt" in payload:
+            return np.asarray(payload["prompt_ids"], np.int32).reshape(-1)
+        if "prompt" in payload:
             if self.tokenizer is None:
                 raise ValueError("server has no tokenizer; send prompt_ids")
             ids = np.asarray(
                 self.tokenizer.encode(str(payload["prompt"])), np.int32
-            )[-self.engine.max_prompt_len :]
-        else:
-            raise ValueError("payload needs 'prompt' or 'prompt_ids'")
+            )
+            # /chat never truncates: silently dropping leading tokens
+            # would desync the turn from the session's retained history
+            return ids[-self.engine.max_prompt_len :] if truncate else ids
+        raise ValueError("payload needs 'prompt' or 'prompt_ids'")
+
+    @staticmethod
+    def _parse_stop(payload: Dict) -> Optional[List[str]]:
+        stop = payload.get("stop")
+        if stop is None:
+            return None
+        if isinstance(stop, str):
+            stop = [stop]
+        if not isinstance(stop, list):
+            raise ValueError("'stop' must be a string or a list of strings")
+        return [str(s) for s in stop]
+
+    def _handle_generate(self, payload: Dict,
+                         request_id: Optional[str] = None) -> Dict:
+        ids = self._encode_prompt(payload)
         unsupported = set(payload) - {
             "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n",
-            "adapter_id", "trace_id",
+            "adapter_id", "trace_id", "stop", "stream",
         }
         if unsupported:
             raise ValueError(
@@ -318,6 +343,7 @@ class InferenceServer:
             )
         n = int(payload.get("n", 1))
         adapter_id = payload.get("adapter_id")
+        stop = self._parse_stop(payload)
         tracer = self.tracer
         traces = None
         if tracer is not None:
@@ -336,6 +362,7 @@ class InferenceServer:
                 adapter_id=adapter_id,
                 request_id=request_id,
                 trace=(traces[0] if traces else None),
+                stop_sequences=stop,
             )]
         else:
             # GRPO-style fan-out: one prompt, n independent completions —
@@ -348,6 +375,7 @@ class InferenceServer:
                 adapter_id=adapter_id,
                 request_id=request_id,
                 traces=traces,
+                stop_sequences=stop,
             )
         for req in reqs:
             req.wait()
@@ -369,13 +397,14 @@ class InferenceServer:
                 "token_logprobs": req.token_logprobs,
                 "finish_reason": req.finish_reason,
                 "latency_s": req.latency_s,
+                "ttft_s": req.ttft_s,
                 # which weights produced this rollout — routers enforce
                 # the staleness bound per-reply, not just per-probe
                 "checkpoint_step": step,
             }
             if request_id is not None:
                 out["request_id"] = request_id
-            if req.finish_reason not in ("eos", "length"):
+            if req.finish_reason not in ("eos", "length", "stop"):
                 # which pipeline stage the request died in — the 504
                 # body surfaces this (satellite: stage attribution)
                 out["stage"] = req.stage
@@ -407,7 +436,7 @@ class InferenceServer:
         }
         if request_id is not None:
             result["request_id"] = request_id
-        if worst not in ("eos", "length"):
+        if worst not in ("eos", "length", "stop"):
             bad = next(r for r in reqs if r.finish_reason == worst)
             result["stage"] = bad.stage
         if traces is not None:
@@ -419,6 +448,196 @@ class InferenceServer:
             result["trace_id"] = traces[0].trace_id
             result["trace"] = merged
         return result
+
+    # ------------------------------------------------------------------
+    # Sessions (/chat) and token streaming (SSE)
+    # ------------------------------------------------------------------
+
+    def _submit_chat(self, payload: Dict, request_id: Optional[str] = None,
+                     stream_q=None):
+        """Resolve the session, build the full-conversation prompt, and
+        submit the turn. Returns ``(req, sess, trace)``. On any submit
+        failure the session's busy flag is cleared so the turn can be
+        retried."""
+        store = getattr(self.engine, "session_store", None)
+        if store is None:
+            raise ValueError(
+                "sessions are off (start the server with inference.sessions)"
+            )
+        unsupported = set(payload) - {
+            "session_id", "prompt", "prompt_ids", "max_new_tokens",
+            "deadline_s", "adapter_id", "stream", "stop", "trace_id",
+        }
+        if unsupported:
+            raise ValueError(
+                f"unsupported chat request keys {sorted(unsupported)}; "
+                "sampling knobs are fixed at server start (inference.gen_kwargs)"
+            )
+        turn_ids = self._encode_prompt(payload, truncate=False)
+        adapter_id = payload.get("adapter_id")
+        session_id = payload.get("session_id")
+        if session_id is None:
+            # new sessions only via an OMITTED id: treating an unknown id
+            # as "create" would silently misread delta tokens as a full
+            # prompt after an eviction the client didn't see
+            sess = store.create(adapter_id)
+        else:
+            sess = store.begin_turn(str(session_id), adapter_id)
+        try:
+            full_ids = (
+                np.concatenate([sess.tokens, turn_ids])
+                if sess.tokens.size else turn_ids
+            )
+            trace = None
+            if self.tracer is not None:
+                trace = self.tracer.new_trace(
+                    trace_id=payload.get("trace_id"), request_id=request_id
+                )
+            req = self.scheduler.submit(
+                full_ids,
+                max_new_tokens=payload.get("max_new_tokens"),
+                deadline_s=payload.get("deadline_s"),
+                adapter_id=adapter_id,
+                request_id=request_id,
+                trace=trace,
+                stop_sequences=self._parse_stop(payload),
+                session=sess,
+                stream=stream_q,
+            )
+        except BaseException:
+            store.end_turn(sess)
+            raise
+        return req, sess, trace
+
+    def _chat_reply(self, req, sess, trace, request_id: Optional[str]) -> Dict:
+        out = {
+            "id": req.id,
+            "session_id": sess.id,
+            "turn": sess.turns,
+            "token_ids": req.token_ids,
+            "token_logprobs": req.token_logprobs,
+            "finish_reason": req.finish_reason,
+            "latency_s": req.latency_s,
+            "ttft_s": req.ttft_s,
+            "checkpoint_step": self._effective_checkpoint_step(),
+            # per-turn retention stats: a follow-up turn asserts
+            # retained_hit and that prefill_tokens is only its delta
+            "retained_blocks": sess.last_reused_blocks,
+            "retained_hit": sess.last_reused_blocks > 0,
+            "prefill_tokens": sess.last_prefill_tokens,
+            "session_tokens": int(sess.tokens.size),
+        }
+        if request_id is not None:
+            out["request_id"] = request_id
+        if req.finish_reason not in ("eos", "length", "stop"):
+            out["stage"] = req.stage
+        if self.tokenizer is not None:
+            out["text"] = self.tokenizer.decode(req.token_ids)
+        if trace is not None:
+            t0 = req.finish_time if req.finish_time is not None else time.monotonic()
+            trace.add("serialize", t0, time.monotonic())
+            out["trace_id"] = trace.trace_id
+            out["trace"] = trace.to_dict()["spans"]
+        return out
+
+    def _handle_chat(self, payload: Dict,
+                     request_id: Optional[str] = None) -> Dict:
+        req, sess, trace = self._submit_chat(payload, request_id)
+        req.wait()
+        return self._chat_reply(req, sess, trace, request_id)
+
+    def _handle_stream(self, handler, path: str, payload: Dict,
+                       request_id: Optional[str] = None) -> None:
+        """Server-sent-events token streaming for /generate and /chat.
+
+        Each delta is one ``data: {"token_ids": [...]}`` event; the last
+        event carries the full non-streaming reply body plus
+        ``"event": "done"`` — concatenating the deltas' token_ids is
+        bitwise identical to the final body's token_ids. The connection
+        closes after the done event (HTTP/1.0 framing: close delimits
+        the body, no chunked encoding needed). Submission errors raise
+        BEFORE any header is written, so they surface as ordinary JSON
+        error replies."""
+        q: "queue.Queue" = queue.Queue()
+        sess = None
+        if path == "/chat":
+            req, sess, trace = self._submit_chat(payload, request_id, stream_q=q)
+        else:
+            ids = self._encode_prompt(payload)
+            unsupported = set(payload) - {
+                "prompt", "prompt_ids", "max_new_tokens", "deadline_s", "n",
+                "adapter_id", "trace_id", "stop", "stream",
+            }
+            if unsupported:
+                raise ValueError(
+                    f"unsupported request keys {sorted(unsupported)}; sampling "
+                    "knobs are fixed at server start (inference.gen_kwargs)"
+                )
+            if int(payload.get("n", 1)) != 1:
+                raise ValueError("streaming supports n=1 only")
+            trace = None
+            if self.tracer is not None:
+                trace = self.tracer.new_trace(
+                    trace_id=payload.get("trace_id"), request_id=request_id
+                )
+            req = self.scheduler.submit(
+                ids,
+                max_new_tokens=payload.get("max_new_tokens"),
+                deadline_s=payload.get("deadline_s"),
+                adapter_id=payload.get("adapter_id"),
+                request_id=request_id,
+                trace=trace,
+                stop_sequences=self._parse_stop(payload),
+                stream=q,
+            )
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.end_headers()
+        broken = False
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if broken:
+                continue  # client went away: keep draining to the sentinel
+            try:
+                handler.wfile.write(b"data: " + json.dumps(item).encode() + b"\n\n")
+                handler.wfile.flush()
+            except OSError:
+                broken = True
+        req.wait()
+        if sess is not None:
+            final = self._chat_reply(req, sess, trace, request_id)
+        else:
+            final = {
+                "id": req.id,
+                "token_ids": req.token_ids,
+                "token_logprobs": req.token_logprobs,
+                "finish_reason": req.finish_reason,
+                "latency_s": req.latency_s,
+                "ttft_s": req.ttft_s,
+                "checkpoint_step": self._effective_checkpoint_step(),
+            }
+            if request_id is not None:
+                final["request_id"] = request_id
+            if req.finish_reason not in ("eos", "length", "stop"):
+                final["stage"] = req.stage
+            if self.tokenizer is not None:
+                final["text"] = self.tokenizer.decode(req.token_ids)
+            if trace is not None:
+                t0 = req.finish_time if req.finish_time is not None else time.monotonic()
+                trace.add("serialize", t0, time.monotonic())
+                final["trace_id"] = trace.trace_id
+                final["trace"] = trace.to_dict()["spans"]
+        final["event"] = "done"
+        if not broken:
+            try:
+                handler.wfile.write(b"data: " + json.dumps(final).encode() + b"\n\n")
+                handler.wfile.flush()
+            except OSError:
+                pass
+        handler.close_connection = True
 
     # ------------------------------------------------------------------
     # Admin surface (fleet supervisor orchestration)
@@ -522,7 +741,7 @@ class InferenceServer:
                     except Exception as e:  # pragma: no cover - defensive
                         self._reply_json(500, {"error": repr(e)})
                     return
-                if path not in ("", "/generate"):
+                if path not in ("", "/generate", "/chat"):
                     self.send_error(404)
                     return
                 # every request gets an id at ingress (client-supplied or
@@ -579,7 +798,41 @@ class InferenceServer:
                         logging.set_trace_context(
                             trace_id=payload["trace_id"], request_id=rid
                         )
-                    result = server._handle_generate(payload, request_id=rid)
+                    if payload.get("stream"):
+                        # SSE path writes its own headers + events; any
+                        # submission error raises before headers go out
+                        # and falls into the handlers below
+                        server._handle_stream(
+                            self, path or "/generate", payload, request_id=rid
+                        )
+                        return
+                    if path == "/chat":
+                        result = server._handle_chat(payload, request_id=rid)
+                    else:
+                        result = server._handle_generate(payload, request_id=rid)
+                except SessionResetError as e:
+                    # the retained state is gone (weights swap, TTL, or
+                    # unknown id): the client re-creates the session by
+                    # resending its full history — NEVER served stale KV
+                    self._reply_json(409, {
+                        "error": str(e), "session_reset": True,
+                        "session_id": e.session_id, "reason": e.reason,
+                        "request_id": rid,
+                    })
+                    return
+                except SessionBusyError as e:
+                    self._reply_json(409, {
+                        "error": str(e), "session_busy": True,
+                        "session_id": e.session_id, "request_id": rid,
+                    })
+                    return
+                except SessionLimitError as e:
+                    self._reply_json(
+                        503,
+                        {"error": str(e), "request_id": rid},
+                        headers={"Retry-After": "1"},
+                    )
+                    return
                 except QueueFullError as e:
                     self._reply_json(
                         503,
@@ -685,6 +938,12 @@ class InferenceServer:
                         # paged-pool occupancy (empty dict when paging is
                         # off) — supervisors surface these per-replica
                         **({"kv": kv} if kv else {}),
+                        # session-store occupancy (sessions on only)
+                        **(
+                            {"sessions": server.engine.session_store.stats()}
+                            if getattr(server.engine, "session_store", None)
+                            is not None else {}
+                        ),
                         # resident adapters (multi-tenant only) — fleet
                         # routers prefer replicas already holding the
                         # request's adapter (no load on the hot path)

@@ -108,6 +108,14 @@ class PPORolloutStorage(BaseRolloutStore):
             group_ids = None
             if all(e.group_id is not None for e in elems):
                 group_ids = np.asarray([e.group_id for e in elems], dtype=np.int32)
+            loss_masks = None
+            if all(e.loss_mask is not None for e in elems):
+                # right-padded like the per-token stats; pad positions are
+                # 0.0 (they are also attention-masked, so this is belt
+                # and braces)
+                loss_masks = np.zeros((len(elems), max_p), dtype=np.float32)
+                for i, e in enumerate(elems):
+                    loss_masks[i, : len(e.loss_mask)] = e.loss_mask
             return PPORLBatch(
                 query_tensors=queries,
                 response_tensors=responses,
@@ -116,6 +124,7 @@ class PPORolloutStorage(BaseRolloutStore):
                 rewards=rewards,
                 h_split=h_split,
                 group_ids=group_ids,
+                loss_masks=loss_masks,
             )
 
         return DataLoader(

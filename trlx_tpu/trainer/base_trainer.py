@@ -505,6 +505,12 @@ class TPUTrainer(BaseRLTrainer):
             multi_tenant=icfg.multi_tenant,
             adapter_store=adapter_store,
         )
+        if icfg.sessions:
+            engine.enable_sessions(
+                ttl_s=icfg.session_ttl_s,
+                max_sessions=icfg.session_max,
+                bytes_budget_mb=icfg.session_bytes_budget_mb,
+            )
         tracer = recorder = None
         if icfg.tracing:
             from trlx_tpu.observability import FlightRecorder, Tracer

@@ -78,6 +78,14 @@ class GRPOConfig(MethodConfig):
     cliprange_reward: float = 10.0
     gen_kwargs: dict = field(default_factory=dict)
     gen_experience_kwargs: Optional[dict] = None
+    # multi-turn rollouts (trlx_tpu/environments.py): registered env name
+    # drives make_experience_multiturn through fleet chat sessions. The G
+    # completions of a group share one env seed (same task) so the
+    # group-relative advantage compares like with like. None (default)
+    # keeps single-turn rollouts bit-identical.
+    multiturn_env: Optional[str] = None
+    multiturn_max_turns: int = 4
+    multiturn_env_kwargs: dict = field(default_factory=dict)
 
 
 @register_trainer
@@ -141,6 +149,10 @@ class GRPOTrainer(PPOTrainer):
             start = query_tensors.shape[1] - 1
             end = start + response_length
             mask = attention_mask[:, start + 1 : end + 1]
+            if batch.loss_masks is not None:
+                # multi-turn rollouts: environment-authored tokens carry
+                # zero loss weight (context, not actions)
+                mask = mask * batch.loss_masks.astype(mask.dtype)
 
             moe_aux = 0.0
             if getattr(self.model_cfg, "moe_experts", 0) > 0:
@@ -358,4 +370,61 @@ class GRPOTrainer(PPOTrainer):
                 )
             )
         self._group_offset += n_rows // G
+        return elements
+
+    # ------------------------------------------------------------------
+    # Multi-turn experience overrides
+    # ------------------------------------------------------------------
+
+    def _multiturn_group_size(self) -> int:
+        """Same-seed groups of G episodes (the multi-turn analogue of G
+        completions per prompt)."""
+        return int(self.config.method.group_size)
+
+    def _multiturn_elements(self, rows, prompt_tensors, sample_outputs,
+                            loss_mask, env_rewards, logprobs, values,
+                            log_ratio, start, max_r):
+        """Group-relative EPISODE advantages: each episode's total
+        environment reward is group-standardized against its G same-seed
+        siblings and broadcast over the response; `values` already
+        carries the reference logprobs this trainer's scorer packs there
+        (the in-loss grpo_kl_coef anchor). The optional init_kl_coef
+        per-token shaping lands on policy tokens only — environment
+        tokens are context, not actions."""
+        method = self.config.method
+        G = int(method.group_size)
+        n = len(rows)
+        assert n % G == 0, "multi-turn chunk must hold whole seed groups"
+
+        totals = env_rewards.sum(axis=1)
+        adv = np.asarray(
+            group_relative_advantages(
+                jnp.asarray(totals.reshape(-1, G)),
+                mode=method.advantage_mode,
+            )
+        ).reshape(-1)
+
+        kl_coef = self.kl_ctl.value
+        if self._sentinel is not None:
+            kl_coef *= self._sentinel.kl_scale(self.iter_count)
+
+        elements = []
+        for i, (_p, ids, _lm, _er, _bl, _h) in enumerate(rows):
+            n_resp = max(min(len(ids), max_r), 1)
+            end = start + n_resp
+            lmask_row = np.asarray(loss_mask[i, :n_resp], np.float32)
+            rewards = (-kl_coef * log_ratio[i, start:end]) * lmask_row
+            rewards = rewards.astype(np.float32) + adv[i]
+            elements.append(
+                PPORLElement(
+                    query_tensor=prompt_tensors[i],
+                    response_tensor=sample_outputs[i, :n_resp],
+                    logprobs=logprobs[i, start:end],
+                    values=values[i, start:end],
+                    rewards=rewards,
+                    group_id=self._group_offset + i // G,
+                    loss_mask=lmask_row.copy(),
+                )
+            )
+        self._group_offset += n // G
         return elements

@@ -10,6 +10,7 @@ host between the two.
 """
 
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
@@ -109,6 +110,18 @@ class PPOConfig(MethodConfig):
     # train/score always see the dense tree. Default off: flag off is
     # bit-identical. Extra field vs the reference config set.
     quantize_frozen_trunk: bool = False
+    # Multi-turn rollouts (tool-use RL): name of a registered
+    # trlx_tpu.environments Environment. When set, make_experience drives
+    # whole episodes through fleet chat sessions (retained KV server-side,
+    # so each policy turn prefills only its delta tokens), masks
+    # environment-authored tokens out of the loss (PPORLElement.loss_mask)
+    # and lands each turn's reward on the last token of that policy turn.
+    # Requires train.rollout_backend="fleet". Default None: the
+    # single-turn path stays bit-identical. Extra fields vs the reference
+    # config set.
+    multiturn_env: Optional[str] = None
+    multiturn_max_turns: int = 4
+    multiturn_env_kwargs: dict = field(default_factory=dict)
 
 
 @register_trainer
@@ -271,6 +284,11 @@ class PPOTrainer(TPUTrainer):
             start = query_tensors.shape[1] - 1
             end = start + response_length
             mask = attention_mask[:, start + 1 : end + 1]
+            if batch.loss_masks is not None:
+                # multi-turn rollouts: environment-authored tokens (tool
+                # output, game state) are context, not actions — they
+                # carry zero loss weight and drop out of masked whitening
+                mask = mask * batch.loss_masks.astype(mask.dtype)
 
             advantages, returns = get_advantages_and_returns(
                 old_values, old_rewards, method.gamma, method.lam,
@@ -690,6 +708,8 @@ class PPOTrainer(TPUTrainer):
         allgathers (_score_samples) — the counterpart of the reference's
         rank-0 score + scatter (accelerate_ppo_trainer.py:292-338), chosen
         so a stochastic reward_fn still yields host-identical stores."""
+        if getattr(self.config.method, "multiturn_env", None):
+            return self.make_experience_multiturn(num_rollouts, iter_count)
         logger.info("Collecting rollouts")
         if self._score_fn is None:
             self._build_score_fn()
@@ -870,6 +890,258 @@ class PPOTrainer(TPUTrainer):
             )
         self.tracker.log(stats, step=iter_count)
         self.push_to_store(ppo_rl_elements)
+
+    # ------------------------------------------------------------------
+    # Multi-turn experience (tool-use environments over fleet sessions)
+    # ------------------------------------------------------------------
+
+    def _multiturn_group_size(self) -> int:
+        """Episodes per shared environment seed. 1 for PPO; GRPO overrides
+        with G so group-relative advantages compare same-task episodes."""
+        return 1
+
+    def _run_episode(self, router, env, seed, max_new, max_turns):
+        """One conversation: alternate policy turns (fleet chat session —
+        the serving replica retains the conversation's KV between turns,
+        so every turn after the first prefills only its delta tokens) with
+        environment responses. Returns ``(prompt_ids, segments,
+        retained_hits)``; segments are ``(kind, ids, logprobs, reward)``
+        with kind "policy" or "env" — the reward belongs to the policy
+        turn it is attached to."""
+        import uuid as _uuid
+
+        tok = self.tokenizer
+        obs = env.reset(seed)
+        prompt_ids = [int(t) for t in tok.encode(obs)]
+        key = f"mt-{_uuid.uuid4().hex[:12]}"
+        segments = []
+        retained_hits = 0
+        turn_ids = prompt_ids
+        try:
+            for t in range(max_turns):
+                out = router.chat(turn_ids, session_key=key,
+                                  max_new_tokens=max_new)
+                resp_ids = [int(x) for x in out["token_ids"]]
+                retained_hits += int(bool(out.get("retained_hit")))
+                text = out.get("text")
+                if text is None:
+                    text = tok.decode(resp_ids)
+                step_out = env.step(text)
+                lps = [float(x) for x in (out.get("token_logprobs") or [])]
+                segments.append(
+                    ("policy", resp_ids, lps[: len(resp_ids)],
+                     float(step_out.reward))
+                )
+                if step_out.done or t == max_turns - 1:
+                    break
+                env_ids = [int(x) for x in tok.encode(step_out.text)]
+                if not env_ids:
+                    # /chat needs a non-empty turn; a silent environment
+                    # still has to hand the floor back to the policy
+                    env_ids = [int(x) for x in tok.encode(" ")]
+                segments.append(("env", env_ids, None, 0.0))
+                turn_ids = env_ids
+        finally:
+            router.end_session(key)
+        return prompt_ids, segments, retained_hits
+
+    def make_experience_multiturn(self, num_rollouts: int = 1024,
+                                  iter_count: int = 0):
+        """Collect multi-turn rollouts (method.multiturn_env): whole
+        environment episodes driven through fleet chat sessions. Each
+        episode becomes ONE rollout element whose response concatenates
+        every turn after the opening observation — policy turns carry
+        loss_mask 1.0 and their turn reward on their last token;
+        environment-authored turns carry loss_mask 0.0 (context, not
+        actions) and no KL penalty. Raw turn rewards are used as-is
+        (environments own their scale; scale_reward does not apply)."""
+        from trlx_tpu.environments import make_environment
+
+        logger.info("Collecting multi-turn rollouts")
+        if self.seq2seq:
+            raise NotImplementedError("multi-turn rollouts are causal-only")
+        if not self._fleet_rollouts_enabled():
+            raise ValueError(
+                "method.multiturn_env requires train.rollout_backend='fleet' "
+                "(episodes run through fleet chat sessions)"
+            )
+        if self._score_fn is None:
+            self._build_score_fn()
+        method = self.config.method
+        env_kwargs = dict(getattr(method, "multiturn_env_kwargs", None) or {})
+        max_turns = max(int(getattr(method, "multiturn_max_turns", 4)), 1)
+        gen_kwargs = self.generate_experience_kwargs or self.generate_kwargs
+        max_new = int(gen_kwargs.get("max_new_tokens", 40))
+        G = max(self._multiturn_group_size(), 1)
+
+        router = self._get_rollout_router()
+        if self._rollout_supervisor is not None:
+            self._push_params_to_thread_replicas()
+            router.set_trainer_step(self._rollout_supervisor.synced_step)
+        else:
+            router.set_trainer_step(iter_count)
+
+        elements: List[PPORLElement] = []
+        accumulated: List[Dict] = []
+        seed0 = int(getattr(self, "_mt_seed_offset", 0))
+        chunk_size = max(int(method.chunk_size), 1)
+        clock = Clock()
+        while len(elements) < num_rollouts:
+            if self._watchdog is not None:
+                self._watchdog.beat()
+            n_chunk = min(chunk_size, num_rollouts - len(elements))
+            n_chunk = max((n_chunk + G - 1) // G * G, G)  # whole groups
+            clock.tick()
+
+            def one(i):
+                env = make_environment(method.multiturn_env, **env_kwargs)
+                # same-seed groups: episodes i with equal i // G play the
+                # same task, differing only by sampling
+                return self._run_episode(
+                    router, env, seed0 + i // G, max_new, max_turns
+                )
+
+            with ThreadPoolExecutor(max_workers=min(n_chunk, 8)) as pool:
+                episodes = list(pool.map(one, range(n_chunk)))
+            seed0 += n_chunk // G
+            stats: Dict[str, float] = {
+                "time/rollout_generate": clock.tick(),
+            }
+            elements.extend(self._episodes_to_elements(episodes, stats))
+            stats["time/rollout_time"] = clock.tick()
+            accumulated.append(stats)
+            logger.info(
+                f"[multi-turn rollout {len(elements)} / {num_rollouts}]"
+            )
+        self._mt_seed_offset = seed0
+        stats = {
+            k: sum(x[k] for x in accumulated) / len(accumulated)
+            for k in accumulated[-1]
+        }
+        stats["kl_ctl_value"] = self.kl_ctl.value
+        if self._rollout_router is not None:
+            for k, v in self._rollout_router.stats().items():
+                if isinstance(v, (int, float)):
+                    stats[f"fleet/{k}"] = float(v)
+        self.mean_kl = stats["policy/sqrt_kl"] ** 2
+        self.tracker.log(stats, step=iter_count)
+        self.push_to_store(elements)
+
+    def _episodes_to_elements(self, episodes, stats):
+        """Pad one chunk of episodes into a fixed-shape batch, run the
+        jitted scorer, splice in the replicas' behavior logprobs on
+        policy tokens, and hand off to `_multiturn_elements` (PPO per-
+        token rewards; GRPO group advantages)."""
+        pad_id = self.tokenizer.pad_token_id
+        n = len(episodes)
+        max_q = max(len(p) for p, _, _ in episodes)
+        rows = []
+        for prompt_ids, segments, hits in episodes:
+            ids: List[int] = []
+            lmask: List[float] = []
+            erew: List[float] = []
+            blps: List[Optional[float]] = []
+            for kind, seg_ids, lps, reward in segments:
+                pol = kind == "policy"
+                ids.extend(seg_ids)
+                lmask.extend([1.0 if pol else 0.0] * len(seg_ids))
+                erew.extend([0.0] * len(seg_ids))
+                if pol and seg_ids:
+                    erew[-1] = float(reward)  # turn reward on last token
+                if pol:
+                    blps.extend(
+                        list(lps) + [None] * (len(seg_ids) - len(lps))
+                    )
+                else:
+                    blps.extend([None] * len(seg_ids))
+            if not ids:  # degenerate episode (empty first reply)
+                ids, lmask, erew, blps = [pad_id], [0.0], [0.0], [None]
+            rows.append((prompt_ids, ids, lmask, erew, blps, hits))
+        # cap the scored width at the train context; a conversation past
+        # it loses its tail tokens (and any reward sitting on them)
+        cap = max(int(self.config.train.seq_length) - max_q, 1)
+        max_r = min(max(len(r[1]) for r in rows), cap)
+
+        prompt_tensors = np.full((n, max_q), pad_id, np.int32)
+        sample_outputs = np.full((n, max_r), pad_id, np.int32)
+        loss_mask = np.zeros((n, max_r), np.float32)
+        env_rewards = np.zeros((n, max_r), np.float32)
+        left = self.tokenizer.padding_side == "left"
+        for i, (p, ids, lm, er, _bl, _h) in enumerate(rows):
+            w = min(len(ids), max_r)
+            if left:
+                prompt_tensors[i, max_q - len(p):] = p
+            else:
+                prompt_tensors[i, : len(p)] = p
+            sample_outputs[i, :w] = ids[:w]
+            loss_mask[i, :w] = lm[:w]
+            env_rewards[i, :w] = er[:w]
+
+        all_tokens = np.concatenate([prompt_tensors, sample_outputs], axis=1)
+        logprobs, values, log_ratio, mean_kl, mean_kl_per_token = self._score_fn(
+            self.train_params, self.frozen_params, self.ref_params,
+            jnp.asarray(all_tokens),
+        )
+        logprobs, values, log_ratio, mean_kl, mean_kl_per_token = jax.device_get(
+            (logprobs, values, log_ratio, mean_kl, mean_kl_per_token)
+        )
+        logprobs = np.array(logprobs)  # device_get can be read-only
+        start = max_q - 1
+        # the replica's sampler is the behavior policy: its logprob for
+        # response token j (all_tokens column max_q + j) lands at scorer
+        # column start + j
+        for i, (_p, _ids, _lm, _er, bl, _h) in enumerate(rows):
+            for j, lp in enumerate(bl[:max_r]):
+                if lp is not None:
+                    logprobs[i, start + j] = lp
+        stats["policy/sqrt_kl"] = float(np.sqrt(max(float(mean_kl), 0.0)))
+        stats["policy/kl_per_token"] = float(
+            np.sqrt(max(float(mean_kl_per_token), 0.0))
+        )
+        stats["rollout/mean_env_reward"] = float(env_rewards.sum(1).mean())
+        stats["rollout/mean_turns"] = float(
+            np.mean([
+                sum(1 for s in segs if s[0] == "policy")
+                for _, segs, _ in episodes
+            ])
+        )
+        stats["rollout/retained_hit_turns"] = float(
+            sum(r[5] for r in rows)
+        )
+        return self._multiturn_elements(
+            rows, prompt_tensors, sample_outputs, loss_mask, env_rewards,
+            np.asarray(logprobs), np.asarray(values), np.asarray(log_ratio),
+            start, max_r,
+        )
+
+    def _multiturn_elements(self, rows, prompt_tensors, sample_outputs,
+                            loss_mask, env_rewards, logprobs, values,
+                            log_ratio, start, max_r):
+        """PPO rewards for one multi-turn chunk: per-token KL penalty on
+        policy tokens only, plus each turn's environment reward on that
+        turn's last token. GAE then runs over the whole response; the
+        loss mask keeps environment tokens out of the objective."""
+        kl_coef = self.kl_ctl.value
+        if self._sentinel is not None:
+            kl_coef *= self._sentinel.kl_scale(self.iter_count)
+        elements = []
+        for i, (_p, ids, _lm, _er, _bl, _h) in enumerate(rows):
+            n_resp = max(min(len(ids), max_r), 1)
+            end = start + n_resp
+            lmask_row = np.asarray(loss_mask[i, :n_resp], np.float32)
+            rewards = (-kl_coef * log_ratio[i, start:end]) * lmask_row
+            rewards = rewards.astype(np.float32) + env_rewards[i, :n_resp]
+            elements.append(
+                PPORLElement(
+                    query_tensor=prompt_tensors[i],
+                    response_tensor=sample_outputs[i, :n_resp],
+                    logprobs=logprobs[i, start:end],
+                    values=values[i, start:end],
+                    rewards=rewards,
+                    loss_mask=lmask_row.copy(),
+                )
+            )
+        return elements
 
     # ------------------------------------------------------------------
     # Loop wiring (reference accelerate_ppo_trainer.py:219-249)

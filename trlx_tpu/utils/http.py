@@ -110,7 +110,13 @@ class RetryingJSONClient:
                             pass  # HTTP-date form: fall back to local backoff
                     raise err from e
                 raise RuntimeError(f"{label} error: {detail}") from e
-            raise RuntimeError(f"{label} error: {e}") from e
+            # 4xx: surface the server's own error detail (clients key off
+            # it — e.g. ChatSession re-creates on "reset" messages)
+            try:
+                detail = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                detail = str(e)
+            raise RuntimeError(f"{label} error: {detail}") from e
         except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
             raise resilience.TransientError(f"{label} unreachable: {e}") from e
         except http.client.HTTPException as e:

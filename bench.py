@@ -80,14 +80,15 @@ except Exception:  # --help etc. without a backend
 ESTIMATED_A100_SAMPLES_PER_SEC = 12.0
 NORTH_STAR_MULTIPLE = 3.0
 
-# bf16 peak FLOP/s per chip by device kind (dense; no sparsity).
-PEAK_FLOPS = [
-    ("v5 lite", 197e12),  # TPU v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v4", 275e12),
-    ("v6", 918e12),  # trillium
-]
+# The FLOP model (PEAK_FLOPS, chip_peak_flops, flops_per_cycle) moved to
+# trlx_tpu/observability/flops.py so the live goodput ledger and this
+# offline harness share one estimate; re-exported here for callers that
+# still import it from bench.
+from trlx_tpu.observability.flops import (  # noqa: E402
+    PEAK_FLOPS,
+    chip_peak_flops,
+    flops_per_cycle,
+)
 
 N_PROMPT = 64
 
@@ -106,16 +107,6 @@ def _proc_start_ticks(pid):
         return int(data.rsplit(") ", 1)[1].split()[19])
     except (OSError, IndexError, ValueError):
         return None
-
-
-def chip_peak_flops() -> float:
-    import jax
-
-    kind = jax.devices()[0].device_kind.lower()
-    for tag, peak in PEAK_FLOPS:
-        if tag in kind:
-            return peak
-    return 197e12  # unknown TPU: assume v5e-class
 
 
 def fast_rollout_requested(argv) -> bool:
@@ -271,103 +262,6 @@ def run_cycle(trainer, config):
     # Force a device->host sync: on the axon relay backend block_until_ready
     # does not block, so timing is only correct after a host copy.
     return float(np.asarray(stats["losses"]["total_loss"]))
-
-
-def flops_per_cycle(model_cfg, n_prompt, n_new, n_rollouts, ppo_epochs,
-                    unfrozen, window_ok: bool = True,
-                    fast_path: bool = False,
-                    trunk_cache: bool = False,
-                    spec_k: int = 0, spec_accept: float = 0.0,
-                    spec_rank: int = 64) -> dict:
-    """Itemized FLOP estimate for one PPO cycle (documented approximations;
-    used only for the MFU estimate, never for vs_baseline).
-
-    Per-token forward cost at context c:
-      L*(8 d^2 + 4 d d_ff)   block matmuls (qkvo 2*4d^2 + mlp 2*2*d*d_ff)
-      + L*4*c*d              attention scores + prob@V
-      + 2 d V                lm_head logits
-    Backward stops at the freeze split (grads are taken w.r.t. the
-    trainable partition only, base_trainer.py grad_fn; XLA prunes below):
-    dX through the lm_head matmul + the `unfrozen` top blocks, plus dW
-    over those same blocks (the tied embedding is frozen, so the head
-    contributes dX but no dW). Generation decode counts the lm_head every
-    step and prefill counts it on all prompt positions (that is what the
-    engine computes)."""
-    d, L, dff, V = (model_cfg.d_model, model_cfg.n_layers,
-                    model_cfg.d_ff, model_cfg.vocab_size)
-    T = n_prompt + n_new
-    blk = 8 * d * d + 4 * d * dff
-    head = 2 * d * V
-
-    def fwd(tokens, avg_ctx, layers=L, with_head=True):
-        return tokens * (layers * blk + layers * 4 * avg_ctx * d
-                         + (head if with_head else 0))
-
-    # generation: prefill the prompt, then n_new cached decode steps
-    if spec_k > 0:
-        # HONEST speculative accounting: charge what the chip actually
-        # computes, including rejected-draft waste. Each round runs k+1
-        # per-row t=1 TRUNK steps (pending + k drafts), k low-rank draft
-        # readouts, and ONE batched suffix verify over k+1 positions (the
-        # suffix blocks plus the full lm_head at each verified position).
-        # Rounds needed = n_new / E[tokens emitted per round], with
-        # E[tokens/round] = 1 + accept_rate * k from the MEASURED accept
-        # rate — a wrong draft head inflates rounds and deflates MFU
-        # instead of silently flattering the denominator.
-        ctx = n_prompt + n_new / 2
-        split_L = max(L - unfrozen, 1)
-        trunk_step = split_L * blk + split_L * 4 * ctx * d
-        suffix_pos = unfrozen * blk + unfrozen * 4 * ctx * d + head
-        draft_head = 2 * d * spec_rank + 2 * spec_rank * V
-        per_round = ((spec_k + 1) * trunk_step + spec_k * draft_head
-                     + (spec_k + 1) * suffix_pos)
-        tokens_per_round = 1.0 + max(0.0, min(1.0, spec_accept)) * spec_k
-        rounds = max(n_new - 1, 0) / tokens_per_round  # token 0 is plain
-        gen = (fwd(n_prompt, n_prompt / 2)  # prefill (emits token 0)
-               + rounds * per_round)
-    else:
-        gen = fwd(n_prompt, n_prompt / 2) + fwd(n_new, n_prompt + n_new / 2)
-    if fast_path:
-        # fast rollout path: policy logprobs + values were captured inside
-        # the sampling loop (already counted under gen), so score is ONLY
-        # the frozen-reference suffix resumed from the captured split
-        # activations, with the unembedding windowed to the n_new response
-        # positions the KL reads
-        score = fwd(T, T / 2, layers=unfrozen, with_head=False) + n_new * head
-    else:
-        # scoring: full policy+value fwd, plus the in-graph frozen-reference
-        # branch re-running the top `unfrozen` blocks + lm_head
-        score = fwd(T, T / 2) + fwd(T, T / 2, layers=unfrozen)
-    if trunk_cache and not fast_path:
-        # trunk cache on the classic schedule: ONE extra frozen-prefix pass
-        # per chunk fills the cache (on the fast schedule the sampler's
-        # in-loop capture makes it free — already counted under gen)
-        score = score + fwd(T, T / 2, layers=L - unfrozen, with_head=False)
-    # one train step: the trunk runs full-width fwd + dX/dW over the
-    # unfrozen top. When the r5 windowed head applies (ppo_trainer
-    # forward_window — no MoE, no deeper value branch, no soft prompt),
-    # the 2·d·V unembedding (fwd + dX) only covers the n_new response
-    # positions the loss reads; otherwise the step really computes the
-    # full-width head and the estimate must charge all T positions.
-    head_tokens = n_new if window_ok else T
-    if trunk_cache:
-        # cached schedule (r6): the frozen prefix comes from the per-chunk
-        # cache, so each inner epoch's forward is suffix-only — the top
-        # `unfrozen` blocks + head — while backward is unchanged (grads
-        # already stop at the first trainable layer)
-        train_fwd = fwd(T, T / 2, layers=unfrozen, with_head=False)
-    else:
-        train_fwd = fwd(T, T / 2, with_head=False)
-    train = (train_fwd + head_tokens * head
-             + fwd(T, T / 2, layers=unfrozen, with_head=False) + head_tokens * head
-             + fwd(T, T / 2, layers=unfrozen, with_head=False))
-    per_sample = gen + score + ppo_epochs * train
-    return {
-        "generate": n_rollouts * gen,
-        "score": n_rollouts * score,
-        "train": n_rollouts * ppo_epochs * train,
-        "total": n_rollouts * per_sample,
-    }
 
 
 def pallas_parity_check() -> dict:
@@ -690,12 +584,18 @@ def main():
     # >=100 cycles / >=45s: r3's 21-cycle/10.6s window was small enough
     # that run-to-run variance decided the MFU verdict (VERDICT r3 weak 1)
     min_cycles, min_seconds = (1, 0.0) if smoke else (100, 45.0)
+    # fault hook for scripts/bench_gate.py: a deliberate per-cycle
+    # slowdown the regression gate must flag (never set in real runs)
+    inject_s = float(
+        os.environ.get("TRLX_BENCH_INJECT_CYCLE_SLEEP_MS", "0") or 0) / 1e3
     cycles = 0
     if classic:
         run_cycle(trainer, config)  # warmup: compiles generate/score/train
         warm = time.time()
         while cycles < min_cycles or (time.time() - warm) < min_seconds:
             run_cycle(trainer, config)
+            if inject_s:
+                time.sleep(inject_s)
             cycles += 1
         elapsed = time.time() - warm
     else:
@@ -710,6 +610,8 @@ def main():
         warm = time.time()
         while cycles < min_cycles or (time.time() - warm) < min_seconds:
             _, pending = trainer.pipelined_cycle(pending)
+            if inject_s:
+                time.sleep(inject_s)
             cycles += 1
         # the timing window closes on a full sync of the last cycle's train
         _ = float(np.asarray(pending[2][0]))

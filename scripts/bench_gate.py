@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Continuous bench regression gate: run bench.py, diff the stdout JSON
+against the committed BENCH_trajectory.json, fail loudly on regression.
+
+The BENCH_r0*.json files record *round* headlines (human-curated, once
+per optimization round); nothing re-runs them, so a silent perf
+regression between rounds only surfaces at the next round. This gate
+closes the loop: tier-1 CI runs `bench_gate.py --smoke` on every push,
+compares the measured smoke metrics against the committed trajectory
+with generous per-metric tolerances (CPU CI boxes are noisy — the gate
+is a tripwire for *gross* regressions like an accidental recompile per
+cycle or a serialized pipeline, not a 5% microbenchmark), and exits
+nonzero naming the regressed metric.
+
+Usage:
+    python scripts/bench_gate.py --smoke            # gate (CI)
+    python scripts/bench_gate.py --smoke --update   # (re)seed trajectory
+    python scripts/bench_gate.py --smoke --runs 3   # best-of-3
+
+The committed trajectory also keeps an append-only `history` of every
+--update, so the smoke numbers form a trajectory over PRs rather than a
+single overwritten point.
+
+Exit codes: 0 pass / trajectory updated; 1 regression (metric named on
+stdout); 2 infrastructure problems (bench crashed, missing trajectory,
+unparseable output).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TRAJECTORY = os.path.join(REPO, "BENCH_trajectory.json")
+
+# metric name -> (key in bench.py stdout JSON, max tolerated fractional
+# regression). 0.6 = fail only when the measured value drops below 40%
+# of the committed baseline: wide enough for shared-CPU CI jitter on the
+# ~0.1s smoke timing window, narrow enough to catch an injected
+# per-cycle stall or a lost overlap schedule (both cut smoke throughput
+# by >2x).
+GATED_METRICS: Dict[str, Any] = {
+    "ppo_samples_per_sec_per_chip": ("value", 0.6),
+    "tokens_per_sec_per_chip": ("tokens_per_sec_per_chip", 0.6),
+    "mfu_estimate": ("mfu_estimate", 0.6),
+}
+
+# a baseline below this is below the metric's own rounding granularity
+# (smoke-CPU mfu_estimate rounds to 1e-4) — ratios against it are noise,
+# so such metrics are reported as skipped rather than gated
+MIN_MEANINGFUL_BASELINE = 1e-3
+
+
+def extract_metrics(bench_stdout: str) -> Dict[str, float]:
+    """Pull the gated metrics out of bench.py's single-line stdout JSON
+    (scans from the last line backwards so stray prints don't break
+    parsing)."""
+    payload: Optional[Dict[str, Any]] = None
+    for line in reversed(bench_stdout.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            payload = json.loads(line)
+            break
+        except ValueError:
+            continue
+    if payload is None:
+        raise ValueError("no JSON object found in bench output")
+    out: Dict[str, float] = {}
+    for metric, (key, _tol) in GATED_METRICS.items():
+        if key in payload:
+            out[metric] = float(payload[key])
+    if not out:
+        raise ValueError(f"bench JSON carried none of the gated keys: "
+                         f"{sorted(k for k, _ in GATED_METRICS.values())}")
+    return out
+
+
+def compare(baseline: Dict[str, Any],
+            current: Dict[str, float]) -> List[Dict[str, Any]]:
+    """Diff `current` against the trajectory's `metrics` section; return
+    one failure record per regressed metric (empty list = gate passes).
+    A metric missing from either side is skipped — the gate only judges
+    what both sides measured. Higher is better for every gated metric."""
+    failures: List[Dict[str, Any]] = []
+    base_metrics = baseline.get("metrics", {})
+    for metric, (_key, default_tol) in GATED_METRICS.items():
+        base = base_metrics.get(metric)
+        if base is None or metric not in current:
+            continue
+        base_value = float(base["value"])
+        allowed = float(base.get("max_regression", default_tol))
+        cur = current[metric]
+        if base_value < float(base.get("min_meaningful",
+                                       MIN_MEANINGFUL_BASELINE)):
+            sys.stderr.write(
+                f"[bench-gate] skipping {metric}: baseline {base_value:g} "
+                f"below meaningful floor\n")
+            continue
+        ratio = cur / base_value
+        if ratio < (1.0 - allowed):
+            failures.append({
+                "metric": metric,
+                "baseline": base_value,
+                "current": cur,
+                "ratio": round(ratio, 4),
+                "allowed_min_ratio": round(1.0 - allowed, 4),
+            })
+    return failures
+
+
+def run_bench(smoke: bool, timeout_s: float) -> Dict[str, float]:
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    if smoke:
+        cmd.append("--smoke")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        cmd, cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout_s,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-4000:] + "\n")
+        raise RuntimeError(f"bench.py exited {proc.returncode}")
+    return extract_metrics(proc.stdout)
+
+
+def load_trajectory(path: str) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def update_trajectory(path: str, current: Dict[str, float],
+                      smoke: bool) -> None:
+    traj = load_trajectory(path) or {"history": []}
+    traj["cmd"] = ("JAX_PLATFORMS=cpu python bench.py"
+                   + (" --smoke" if smoke else ""))
+    traj["metrics"] = {
+        metric: {
+            "value": current[metric],
+            "max_regression": GATED_METRICS[metric][1],
+            "direction": "higher_better",
+        }
+        for metric in current
+    }
+    traj.setdefault("history", []).append({
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metrics": dict(current),
+    })
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(traj, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="run bench.py --smoke (tiny model, 1 cycle)")
+    ap.add_argument("--update", action="store_true",
+                    help="write the measured metrics as the new baseline "
+                         "instead of gating")
+    ap.add_argument("--trajectory", default=DEFAULT_TRAJECTORY,
+                    help="path to the committed trajectory JSON")
+    ap.add_argument("--runs", type=int, default=2,
+                    help="bench runs; the BEST value per metric is gated "
+                         "(absorbs one-off CI hiccups)")
+    ap.add_argument("--timeout-s", type=float, default=480.0,
+                    help="per-run subprocess timeout")
+    args = ap.parse_args(argv)
+
+    runs: List[Dict[str, float]] = []
+    for i in range(max(args.runs, 1)):
+        try:
+            m = run_bench(args.smoke, args.timeout_s)
+        except Exception as e:
+            sys.stderr.write(f"[bench-gate] run {i + 1} failed: {e}\n")
+            continue
+        sys.stderr.write(f"[bench-gate] run {i + 1}: "
+                         + json.dumps(m) + "\n")
+        runs.append(m)
+    if not runs:
+        print("BENCH GATE ERROR: every bench run failed")
+        return 2
+    current = {
+        metric: max(r[metric] for r in runs if metric in r)
+        for metric in GATED_METRICS
+        if any(metric in r for r in runs)
+    }
+
+    if args.update:
+        update_trajectory(args.trajectory, current, args.smoke)
+        print(json.dumps({"updated": args.trajectory, "metrics": current}))
+        return 0
+
+    traj = load_trajectory(args.trajectory)
+    if traj is None:
+        print(f"BENCH GATE ERROR: no trajectory at {args.trajectory}; "
+              f"seed it with: python scripts/bench_gate.py "
+              f"{'--smoke ' if args.smoke else ''}--update")
+        return 2
+    failures = compare(traj, current)
+    if failures:
+        for f in failures:
+            print(f"BENCH REGRESSION: {f['metric']} = {f['current']:g} "
+                  f"is {f['ratio']:.0%} of baseline {f['baseline']:g} "
+                  f"(allowed >= {f['allowed_min_ratio']:.0%})")
+        return 1
+    print(json.dumps({"bench_gate": "pass", "metrics": current,
+                      "baseline": {k: v["value"]
+                                   for k, v in traj["metrics"].items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,6 +51,8 @@ from concurrent import futures
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from trlx_tpu import resilience
+from trlx_tpu.inference.metrics import dedupe_metadata
+from trlx_tpu.observability.slo import SLOEngine
 from trlx_tpu.utils import logging
 from trlx_tpu.utils.http import RetryingJSONClient
 
@@ -184,12 +186,21 @@ class ReplicaRouter:
         hedge_max_delay_s: float = 5.0,
         _sleep=None,
         tracer=None,
+        slos=None,
+        slo_postmortem_dir: Optional[str] = None,
     ):
         # cross-process tracing (None = off): every dispatch opens a
         # parent span, each replica attempt / hedge / failover is a child
         # span, and the winner's replica-returned span tree is grafted
         # under its attempt — one timeline per request across processes
         self.tracer = tracer
+        # fleet-level SLO feed: router-side dispatch wall time per post.
+        # This is deliberately measured from the caller's side — a
+        # replica whose handler stalls before the scheduler ever sees the
+        # request (overloaded accept loop, injected latency fault) is
+        # invisible to that replica's own scheduler histograms but fully
+        # visible here.
+        self.slo = SLOEngine(slos=slos, postmortem_dir=slo_postmortem_dir)
         # an empty fleet is allowed (a supervisor registers members as
         # they come up); dispatch against it degrades via
         # FleetUnavailableError like a whole-fleet outage
@@ -354,12 +365,14 @@ class ReplicaRouter:
                 rep.inflight -= 1
                 rep.failures += 1
                 rep.last_error = str(e)
+            self.slo.record(latency_s=time.monotonic() - t0, ok=False)
             raise
         dt = time.monotonic() - t0
         with self._lock:
             rep.inflight -= 1
             rep.served += 1
             self._latencies.append(dt)
+        self.slo.record(latency_s=dt)
         return out
 
     def _hedge_delay(self) -> Optional[float]:
@@ -415,6 +428,9 @@ class ReplicaRouter:
                 if dispatch is not None:
                     dispatch.end(status="error")
                     self.tracer.finish(trace)
+                # whole-fleet unavailability is a rejection, not a
+                # latency sample: the request never reached a replica
+                self.slo.record(ok=False, rejected=True)
                 raise FleetUnavailableError(
                     f"no eligible replica (tried {[r.url for r in tried] or 'none'};"
                     f" last error: {last_exc})"
@@ -563,12 +579,14 @@ class ReplicaRouter:
                 rep.inflight -= 1
                 rep.failures += 1
                 rep.last_error = str(e)
+            self.slo.record(latency_s=time.monotonic() - t0, ok=False)
             raise
         dt = time.monotonic() - t0
         with self._lock:
             rep.inflight -= 1
             rep.served += 1
             self._latencies.append(dt)
+        self.slo.record(latency_s=dt)
         return out
 
     def _chat_fresh(self, ids: List[int], **kwargs) -> (
@@ -796,7 +814,8 @@ class ReplicaRouter:
             lines.append(f"# TYPE {ns}_{name}_total counter")
             for rep in rows:
                 lines.append(f'{ns}_{name}_total{{url="{rep.url}"}} {rep.kv[key]}')
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n" + self.slo.render_prometheus(ns=ns)
+        return dedupe_metadata(text)
 
     def close(self, timeout_s: float = 5.0) -> None:
         """Tear down the dispatch pools. Pending (not yet started) work

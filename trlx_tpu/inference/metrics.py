@@ -13,7 +13,31 @@ case, unchanged.
 """
 
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
+
+
+def dedupe_metadata(text: str) -> str:
+    """Drop repeated `# HELP` / `# TYPE` lines for the same metric name.
+
+    Concatenating independent registry renders (fleet/supervisor stitch
+    per-replica registries plus their own series) repeats metadata for
+    any series both sides export, which violates the exposition format
+    ("Only one TYPE line may exist for a given metric name"). Keeps the
+    FIRST occurrence of each (HELP|TYPE, metric) pair; sample lines pass
+    through untouched."""
+    seen = set()
+    out: List[str] = []
+    for line in text.split("\n"):
+        if line.startswith("# TYPE ") or line.startswith("# HELP "):
+            parts = line.split(" ", 3)  # "#", kind, metric, [rest]
+            key = (parts[1], parts[2] if len(parts) > 2 else "")
+            if key in seen:
+                continue
+            seen.add(key)
+        out.append(line)
+    return "\n".join(out)
+
 
 def _series(name: str, labels: Optional[Dict[str, str]]) -> str:
     """Full exposition-format series name. Labels render sorted so the
@@ -42,14 +66,23 @@ class _Histogram:
         self.counts = [0] * (len(buckets) + 1)  # +Inf tail
         self.total = 0.0
         self.n = 0
+        # OpenMetrics exemplars: bucket index -> (value, trace_id, unix
+        # ts) of the LAST traced observation that landed there. A p99
+        # bucket on /metrics then links to the /debug/trace entry that
+        # caused it.
+        self.exemplars: Dict[int, Tuple[float, str, float]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         for i, edge in enumerate(self.buckets):
             if value <= edge:
                 self.counts[i] += 1
+                idx = i
                 break
         else:
             self.counts[-1] += 1
+            idx = len(self.buckets)
+        if trace_id:
+            self.exemplars[idx] = (value, str(trace_id), time.time())
         self.total += value
         self.n += 1
 
@@ -91,12 +124,26 @@ class InferenceMetrics:
         with self._lock:
             return self._counters.get(name, self._gauges.get(name, 0.0))
 
-    def observe(self, name: str, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+    def observe(self, name: str, value: float, labels: Optional[Dict[str, str]] = None,
+                trace_id: Optional[str] = None) -> None:
         name = _series(name, labels)
         with self._lock:
             if name not in self._hists:
                 self._hists[name] = _Histogram()
-            self._hists[name].observe(value)
+            self._hists[name].observe(value, trace_id=trace_id)
+
+    def histograms_snapshot(self) -> Dict[str, Tuple[Tuple[float, ...], List[int], float, int]]:
+        """{series name: (bucket edges, per-bucket counts incl. the +Inf
+        tail, sum, count)} — the SLO engine's snapshot-diff feed."""
+        with self._lock:
+            return {
+                name: (h.buckets, list(h.counts), h.total, h.n)
+                for name, h in self._hists.items()
+            }
+
+    def counters_snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
 
     def record_token_rate(self, tokens: int, step_seconds: float, alpha: float = 0.2) -> None:
         if step_seconds <= 0:
@@ -134,12 +181,28 @@ class InferenceMetrics:
                 if base not in seen_hist_types:
                     seen_hist_types.add(base)
                     lines.append(f"# TYPE {NAMESPACE}_{base} histogram")
+                def _ex(idx: int) -> str:
+                    # OpenMetrics exemplar: `... # {trace_id="..."} v ts`
+                    # — links the bucket to the request trace that landed
+                    # in it (resolvable via GET /debug/trace)
+                    ex = h.exemplars.get(idx)
+                    if ex is None:
+                        return ""
+                    value, trace_id, ts = ex
+                    return f' # {{trace_id="{trace_id}"}} {value} {ts}'
+
                 cum = 0
-                for edge, c in zip(h.buckets, h.counts):
+                for i, (edge, c) in enumerate(zip(h.buckets, h.counts)):
                     cum += c
-                    lines.append(f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="{edge}"}} {cum}')
+                    lines.append(
+                        f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="{edge}"}} '
+                        f'{cum}{_ex(i)}'
+                    )
                 cum += h.counts[-1]
-                lines.append(f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="+Inf"}} {cum}')
+                lines.append(
+                    f'{NAMESPACE}_{base}_bucket{{{label_prefix}le="+Inf"}} '
+                    f'{cum}{_ex(len(h.buckets))}'
+                )
                 suffix = "{" + label_body if brace else ""
                 lines.append(f"{NAMESPACE}_{base}_sum{suffix} {h.total}")
                 lines.append(f"{NAMESPACE}_{base}_count{suffix} {h.n}")

@@ -764,7 +764,13 @@ class Scheduler:
                     self.engine.trace_buf = None
                 self._requeue(batch, slots)
                 return
-        self.metrics.observe("prefill_latency_seconds", time.perf_counter() - t0)
+        self.metrics.observe(
+            "prefill_latency_seconds", time.perf_counter() - t0,
+            # exemplar: any traced request of the batch links the bucket
+            # back to its /debug/trace entry
+            trace_id=next((r.trace.trace_id for r in batch
+                           if r.trace is not None), None),
+        )
         self.metrics.inc("prefill_batches_total")
         if traced:
             ts1 = time.monotonic()
@@ -819,7 +825,11 @@ class Scheduler:
             emitted += n_slot
             if n_slot and req.first_token_time is None:
                 req.first_token_time = now
-                self.metrics.observe("ttft_seconds", req.first_token_time - req.enqueue_time)
+                self.metrics.observe(
+                    "ttft_seconds", req.first_token_time - req.enqueue_time,
+                    trace_id=(req.trace.trace_id if req.trace is not None
+                              else None),
+                )
             if multi_tenant and n_slot:
                 t = self._tenant(req)
                 tenant_emitted[t] = tenant_emitted.get(t, 0) + n_slot
@@ -1017,8 +1027,10 @@ class Scheduler:
                 stage=req.stage, tokens=len(req.token_ids),
             )
         self.metrics.inc(f'requests_total{{outcome="{reason}"}}')
+        trace_id = req.trace.trace_id if req.trace is not None else None
         if req.latency_s is not None:
-            self.metrics.observe("request_latency_seconds", req.latency_s)
+            self.metrics.observe("request_latency_seconds", req.latency_s,
+                                 trace_id=trace_id)
         if getattr(self.engine, "multi_tenant", False):
             tenant = self._tenant(req)
             self.metrics.inc(
@@ -1030,5 +1042,6 @@ class Scheduler:
                     "adapter_request_latency_seconds",
                     req.latency_s,
                     labels={"adapter": tenant},
+                    trace_id=trace_id,
                 )
         req._done.set()

@@ -20,7 +20,11 @@ Endpoints:
   for adapter affinity).
 - ``GET /metrics`` — Prometheus text: queue depth, slot occupancy,
   prefill/decode/request latency histograms, tokens/sec (per-adapter
-  labeled series on multi-tenant servers).
+  labeled series on multi-tenant servers), plus ``slo_burn_rate``
+  gauges; latency buckets carry OpenMetrics exemplar trace ids when
+  tracing is on.
+- ``GET /debug/slo`` — the SLO burn-rate report: per-SLO fast/slow
+  window burn rates, alert states, lifetime error budget.
 - ``GET/POST /admin/adapters`` — multi-tenant control plane: GET lists
   resident + on-disk adapters and store stats; POST takes one of
   ``{"load": name}`` / ``{"evict": name}`` / ``{"reload": name}``.
@@ -61,6 +65,8 @@ from trlx_tpu.inference.sessions import (
     SessionLimitError,
     SessionResetError,
 )
+from trlx_tpu.inference.metrics import dedupe_metadata
+from trlx_tpu.observability.slo import SLOEngine
 from trlx_tpu.observability.tracing import new_id
 from trlx_tpu.utils import logging
 
@@ -250,10 +256,22 @@ class InferenceServer:
         checkpoint_loader=load_checkpoint_params,
         drain_on_term_s: float = 30.0,
         tracer=None,
+        slos=None,
+        slo_postmortem_dir: Optional[str] = None,
     ):
         self.scheduler = scheduler
         self.engine = scheduler.engine
         self.metrics = scheduler.metrics
+        # SLO burn-rate engine over this replica's own registry: fed by
+        # snapshot-diffing the scheduler's histograms/counters on every
+        # /metrics scrape or /debug/slo poll (no hook in the request
+        # path). Alert transitions land in the scheduler's flight
+        # recorder when one exists.
+        self.slo = SLOEngine(
+            slos=slos,
+            recorder=getattr(scheduler, "recorder", None),
+            postmortem_dir=slo_postmortem_dir,
+        )
         # one tracer per replica, shared with the scheduler: the server
         # opens traces at ingress, the scheduler closes them at finish
         self.tracer = tracer if tracer is not None else getattr(scheduler, "tracer", None)
@@ -893,9 +911,18 @@ class InferenceServer:
                     except (ValueError, AdapterError) as e:
                         self._reply_json(400, {"error": str(e)})
                     return
+                if path == "/debug/slo":
+                    server.slo.ingest_registry(server.metrics)
+                    self._reply_json(200, server.slo.evaluate())
+                    return
                 if path == "/metrics":
+                    server.slo.ingest_registry(server.metrics)
+                    text = dedupe_metadata(
+                        server.metrics.render()
+                        + server.slo.render_prometheus(ns="trlx_tpu_inference")
+                    )
                     self._reply(
-                        200, server.metrics.render().encode(),
+                        200, text.encode(),
                         content_type="text/plain; version=0.0.4",
                     )
                     return

@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from trlx_tpu import resilience
 from trlx_tpu.inference.fleet import ReplicaRouter
+from trlx_tpu.inference.metrics import dedupe_metadata
 from trlx_tpu.utils import logging
 
 logger = logging.get_logger(__name__)
@@ -345,7 +346,11 @@ class FleetSupervisor:
             active_urls = [s.url for s in self.seats
                            if s.role == "active" and s.url]
             if self._router is None:
-                self._router = ReplicaRouter(active_urls, **self._router_kwargs)
+                kwargs = dict(self._router_kwargs)
+                # the router's SLO engine dumps its error-budget
+                # postmortems next to the supervisor's crash bundles
+                kwargs.setdefault("slo_postmortem_dir", self.postmortem_dir)
+                self._router = ReplicaRouter(active_urls, **kwargs)
         self._thread = threading.Thread(
             target=self._run, name="trlx-tpu-fleet-supervisor", daemon=True
         )
@@ -736,7 +741,8 @@ class FleetSupervisor:
         text = "\n".join(lines) + "\n"
         if self._router is not None:
             text += self._router.render_metrics()
-        return text
+        # concatenated registries can repeat HELP/TYPE for shared series
+        return dedupe_metadata(text)
 
     # -- /metrics HTTP endpoint ----------------------------------------
 
@@ -749,6 +755,15 @@ class FleetSupervisor:
                 if path == "/metrics":
                     body = sup.render_metrics().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif path == "/debug/slo":
+                    # fleet-level SLO state, fed from router dispatch
+                    # latencies (visible even when a replica's own
+                    # scheduler never saw the slow request)
+                    try:
+                        body = json.dumps(sup.router.slo.evaluate()).encode()
+                    except RuntimeError:
+                        body = json.dumps({"error": "router not built"}).encode()
+                    ctype = "application/json"
                 elif path in ("", "/healthz"):
                     stats = sup.stats()
                     stats["status"] = (

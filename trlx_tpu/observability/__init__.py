@@ -1,7 +1,9 @@
 """End-to-end observability for the serving fleet and training loop:
 request tracing (`Tracer`/`Span`/`RequestTrace`), the training phase
-timeline (`PhaseTimeline`), per-component flight recorders, the
-postmortem bundler, and the Chrome-trace/Perfetto exporter.
+timeline (`PhaseTimeline`), the goodput ledger (`GoodputLedger` + the
+shared FLOP model in `flops`), SLO burn-rate evaluation (`SLOEngine`),
+per-component flight recorders, the postmortem bundler, and the
+Chrome-trace/Perfetto exporter.
 
 Everything here is dependency-free and OFF by default — components hold
 `tracer = None` / `recorder = None` unless `train.tracing` /
@@ -13,10 +15,22 @@ from trlx_tpu.observability.flight_recorder import (
     all_recorders,
     snapshot_all,
 )
+from trlx_tpu.observability.flops import (
+    PEAK_FLOPS,
+    chip_peak_flops,
+    flops_per_cycle,
+    flops_per_sample,
+)
+from trlx_tpu.observability.goodput import WASTE_CAUSES, GoodputLedger
 from trlx_tpu.observability.postmortem import (
     dump_postmortem,
     maybe_dump,
     reset_triggers,
+)
+from trlx_tpu.observability.slo import (
+    SLO,
+    SLOEngine,
+    default_slos,
 )
 from trlx_tpu.observability.tracing import (
     EPOCH_OFFSET,
@@ -32,12 +46,21 @@ from trlx_tpu.observability.tracing import (
 __all__ = [
     "EPOCH_OFFSET",
     "FlightRecorder",
+    "GoodputLedger",
+    "PEAK_FLOPS",
     "PhaseTimeline",
     "RequestTrace",
+    "SLO",
+    "SLOEngine",
     "Span",
     "Tracer",
+    "WASTE_CAUSES",
     "all_recorders",
+    "chip_peak_flops",
+    "default_slos",
     "dump_postmortem",
+    "flops_per_cycle",
+    "flops_per_sample",
     "maybe_dump",
     "new_id",
     "reset_triggers",

@@ -329,6 +329,11 @@ class PhaseTimeline:
         self._first: Dict[str, float] = {}
         self._steady: Dict[str, List[float]] = {}
         self._drained_first: set = set()
+        # optional GoodputLedger (observability/goodput.py): every add()
+        # is forwarded as observe_phase so wall-clock attribution rides
+        # the same hooks as the timing stats. Assigned, never constructed
+        # here — the timeline stays dependency-free.
+        self.ledger = None
 
     def phase(self, name: str, step: Optional[int] = None) -> "_PhaseCtx":
         return _PhaseCtx(self, name, step)
@@ -351,6 +356,10 @@ class PhaseTimeline:
                 },
             }
             self.spans.append(span)
+        ledger = self.ledger
+        if ledger is not None:  # outside the lock: the ledger has its own
+            ledger.observe_phase(name, t0, t1, first=first,
+                                 attrs=span["attrs"])
 
     def drain_stats(self) -> Dict[str, float]:
         """`timing/<phase>_ms` (steady-state mean since last drain) and

@@ -21,6 +21,7 @@ import json
 import os
 import pickle
 import shutil
+import time
 from abc import abstractmethod
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -200,6 +201,15 @@ class TPUTrainer(BaseRLTrainer):
         # the end of learn(). _last_stats keeps the latest host-side
         # stats dict for postmortem bundles.
         self._timeline = PhaseTimeline() if config.train.tracing else None
+        # Goodput ledger (rides the timeline's phase hooks): attributes
+        # every wall second of learn() to a cause and computes live MFU
+        # with bench.py's FLOP model. Only exists when tracing is on.
+        self._goodput = None
+        if self._timeline is not None:
+            from trlx_tpu.observability.goodput import GoodputLedger
+
+            self._goodput = GoodputLedger()
+            self._timeline.ledger = self._goodput
         self._last_stats: Dict[str, Any] = {}
         self._loop_pos: Optional[Dict[str, int]] = None
         self._resume_pos: Optional[Dict[str, int]] = None
@@ -955,6 +965,14 @@ class TPUTrainer(BaseRLTrainer):
                     logger.info(f"Phase timeline (Perfetto) written to {path}")
                 except Exception:
                     logger.exception("failed to write the phase timeline")
+            if self._goodput is not None:
+                try:
+                    path = self._goodput.write(os.path.join(
+                        self.config.train.trace_dir or "logs/traces",
+                        "goodput.json"))
+                    logger.info(f"Goodput ledger written to {path}")
+                except Exception:
+                    logger.exception("failed to write the goodput ledger")
 
     def _next_pos(self, epoch_idx: int, inner_idx: int) -> Dict[str, int]:
         """Continuation position AFTER inner epoch (epoch_idx, inner_idx)
@@ -1089,6 +1107,8 @@ class TPUTrainer(BaseRLTrainer):
                             "train_minibatch", step=self.iter_count
                         ):
                             stats = self.train_minibatch(minibatch)
+                        if self._goodput is not None:
+                            self._goodput.note_train_rows(self.mb_size)
                     else:
                         stats = self.train_minibatch(minibatch)
                     self.iter_count += 1
@@ -1179,6 +1199,24 @@ class TPUTrainer(BaseRLTrainer):
             # timing/<phase>_ms (steady-state mean since the last drain)
             # + timing/<phase>_first_ms (compile+run, reported once)
             stats.update(self._timeline.drain_stats())
+        if self._goodput is not None:
+            # goodput/* (live MFU, throughput, wasted seconds by cause)
+            # plus a crash-durable flush: the ledger artifact and the
+            # phase timeline land on disk EVERY stats step, not only at
+            # learn() shutdown, so a killed run still leaves both
+            stats.update(self._goodput.drain_stats())
+            trace_dir = self.config.train.trace_dir or "logs/traces"
+            try:
+                self._goodput.write(os.path.join(trace_dir, "goodput.json"))
+                # the timeline artifact grows with the span count, so its
+                # flush is throttled (the json above is O(1)-sized)
+                now = time.monotonic()
+                if now - getattr(self, "_timeline_flushed", 0.0) >= 30.0:
+                    self._timeline_flushed = now
+                    self._timeline.write(
+                        os.path.join(trace_dir, "train_timeline.json"))
+            except Exception:
+                logger.exception("periodic goodput/timeline flush failed")
         self._last_stats = stats
         if self._watchdog is not None:
             self._watchdog.beat()
@@ -1339,7 +1377,15 @@ class TPUTrainer(BaseRLTrainer):
             f"{path} after: " + "; ".join(e.reasons)
         )
         ladder_state = sen.state_dict()
-        self.load(path)  # restores params/opt_state/PRNG/loop-pos bit-exactly
+        if self._goodput is not None:
+            # the restore below plus every rollout phase until the first
+            # post-rewind train step is repaid work — charge waste/rewind
+            self._goodput.note_rewind()
+        if self._timeline is not None:
+            with self._timeline.phase("sentinel_restore", step=self.iter_count):
+                self.load(path)  # restores params/opt_state/PRNG/loop-pos bit-exactly
+        else:
+            self.load(path)  # restores params/opt_state/PRNG/loop-pos bit-exactly
         sen.load_state_dict(ladder_state)
         sen.note_rewind(self.iter_count)
         # diverge the PRNG stream from the pinned one: the chunk that bred

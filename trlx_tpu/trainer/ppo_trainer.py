@@ -213,6 +213,27 @@ class PPOTrainer(TPUTrainer):
             and getattr(self.model_cfg, "prompt_tokens", 0) == 0
         )
 
+    def _goodput_configure(self, n_prompt: int, n_new: int) -> None:
+        """Price the goodput ledger's per-sample FLOPs with the same
+        knobs bench.py passes to flops_per_cycle — live MFU and the
+        offline bench MFU share one model by construction. Re-done every
+        chunk (pure arithmetic): the speculative accept rate is measured,
+        so it converges as rounds accumulate."""
+        spec_k = self._spec_k_effective()
+        rounds = int(getattr(self, "spec_decode_rounds", 0))
+        accepted = int(getattr(self, "spec_decode_accepted", 0))
+        accept = accepted / (spec_k * rounds) if rounds and spec_k else 0.0
+        self._goodput.configure_unit_flops(
+            self.model_cfg, n_prompt, n_new,
+            unfrozen=self.model_cfg.n_layers - self.split,
+            window_ok=(self._window_loss_ok()
+                       and getattr(self.model_cfg, "moe_experts", 0) == 0),
+            fast_path=False,  # make_experience scores with the full fwd
+            trunk_cache=self._trunk_cache_available(),
+            spec_k=spec_k, spec_accept=accept,
+            spec_rank=int(getattr(self.config.method, "spec_draft_rank", 64)),
+        )
+
     def make_loss_fn(self) -> Callable:
         model = self.model
         method = self.config.method
@@ -769,6 +790,10 @@ class PPOTrainer(TPUTrainer):
                 self._timeline.add(
                     "rollout_generate", t_chunk0, time.monotonic(),
                     step=iter_count, rows=n_this,
+                    # a fleet chunk that fell back to local generation is
+                    # degraded capacity — the goodput ledger charges its
+                    # wall time to waste/fleet_degraded
+                    degraded=bool(use_fleet and not out.get("fleet")),
                 )
             # throughput over REAL generated tokens (the validity mask —
             # padding after eos doesn't count); tick() returns ms
@@ -849,6 +874,15 @@ class PPOTrainer(TPUTrainer):
                     elements, scores, scores_mask, outputs
                 )
                 stats["sentinel/quarantined_rows"] = float(n_dropped)
+                if n_dropped and self._goodput is not None:
+                    # the dropped rows' share of this chunk's wall time is
+                    # MOVED (not added) into waste/quarantined so the
+                    # ledger keeps summing to wall time
+                    self._goodput.note_quarantine(
+                        n_dropped,
+                        (n_dropped / max(n_this, 1))
+                        * (time.monotonic() - t_chunk0),
+                    )
                 stats["rollout/entropy"] = (
                     float(np.mean([-np.mean(e.logprobs) for e in elements]))
                     if elements else 0.0
@@ -861,6 +895,9 @@ class PPOTrainer(TPUTrainer):
                 self._timeline.add(
                     "rollout_process", t_proc0, time.monotonic(), step=iter_count
                 )
+            if self._goodput is not None:
+                self._goodput_configure(prompt_tensors.shape[1], max_new)
+                self._goodput.note_rollout_chunk(n_this)
             stats["policy/sqrt_kl"] = float(np.sqrt(max(mean_kl, 0.0)))
             stats["policy/kl_per_token"] = float(np.sqrt(max(mean_kl_per_token, 0.0)))
             accumulated_stats.append(stats)
@@ -1227,7 +1264,12 @@ class PPOTrainer(TPUTrainer):
         metadata = {
             k: v for k, v in batch.items() if k not in ("input_ids", "attention_mask")
         }
+        t_rw0 = time.monotonic()
         score_rows = self._score_samples(str_samples, str_prompts, str_outputs, metadata)
+        if self._timeline is not None:
+            # the host reward round trip, split out of rollout_score so
+            # the goodput ledger can attribute reward RTT as its own cause
+            self._timeline.add("host_reward", t_rw0, time.monotonic())
         if stats is not None and clock is not None:
             stats["time/rollout_score"] = clock.tick()
         S = max(len(r) for r in score_rows)

@@ -318,13 +318,17 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     method = config.method
     peak = chip_peak_flops()
 
-    def timed(fn, sync, n=reps):
+    hbm = getattr(trainer, "_hbm", None)
+
+    def timed(fn, sync, n=reps, phase=None):
         ts = []
         for _ in range(n):
             t0 = time.time()
             out = fn()
             np.asarray(sync(out))
             ts.append(time.time() - t0)
+        if phase is not None and hbm is not None:
+            hbm.sample(phase)
         return min(ts), out
 
     # relay RTT: fetch a FRESH tiny device array each rep (jax.Array caches
@@ -341,6 +345,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     t, (batch, out) = timed(
         lambda: trainer.dispatch_rollout_generation(),
         lambda r: r[1]["samples"][0, 0],
+        phase="generate",
     )
     times["generate"] = max(t - rtt, 1e-9)
 
@@ -364,12 +369,14 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
         # fast path: the generation above already captured in-loop policy
         # logprobs/values, so score = the frozen-ref windowed suffix only
         t, spec = timed(
-            lambda: trainer._dispatch_fast_score(out), lambda s: s[4]
+            lambda: trainer._dispatch_fast_score(out), lambda s: s[4],
+            phase="score",
         )
         times["score"] = max(t - rtt, 1e-9)
     elif trainer._spec_path_available():
         t, spec = timed(
-            lambda: trainer._dispatch_spec_score(out), lambda s: s[4]
+            lambda: trainer._dispatch_spec_score(out), lambda s: s[4],
+            phase="score",
         )
         times["score"] = max(t - rtt, 1e-9)
 
@@ -409,6 +416,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
                 jnp.float32(trainer.kl_ctl.value),
             ),
             lambda r: r[0].rewards[0, 0],
+            phase="score",
         )
         times["score"] = max(t - rtt, 1e-9)
         chunk = chunk[0]
@@ -425,6 +433,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
                 chunk, captured=out.get("trunk_cache")
             ),
             lambda c: c.h_split[0, 0, 0],
+            phase="cache_trunk",
         )
         times["cache_trunk"] = max(t - rtt, 1e-9)
         extra["train_schedule"] = "trunk_cache"
@@ -434,6 +443,7 @@ def measure_phases(trainer, config, flops, n_chips, reps=3):
     t, _ = timed(
         lambda: trainer.train_epochs_from_chunk(chunk, method.ppo_epochs),
         lambda st: st["losses"]["total_loss"],
+        phase="train",
     )
     times["train"] = max(t - rtt, 1e-9)
     if trunk_cache:
@@ -579,6 +589,22 @@ def main():
     int8 = int8_requested(sys.argv[1:])
     trainer, config = build_trainer(smoke, fast=fast, trunk_cache=trunk_cache,
                                     spec_decode=spec_decode, int8=int8)
+    # Compile/HBM forensics for the run: bench keeps train.tracing OFF
+    # (the headline measures the flag-off hot path), but the ledgers are
+    # explicit context objects, so attaching them directly instruments
+    # every lazily-built jit without the timeline machinery. A compile
+    # landing INSIDE the timed window is itself a perf bug (retrace
+    # storm) — timed_window_compiles below is gated at zero by
+    # scripts/bench_gate.py.
+    from trlx_tpu.observability import CompileLedger, HBMLedger
+
+    trainer._compile_ledger = CompileLedger()
+    # the same-process A/Bs in measure_phases compile a second variant on
+    # purpose (train with h_split=None for the trunk-cache A/B; plain
+    # generate for the spec-decode A/B), so two train programs are
+    # expected here even though the library-wide budget is 1
+    trainer._compile_ledger.declare_budget("train_scan", 2)
+    trainer._hbm = HBMLedger()
     n_chips = max(jax.device_count(), 1)
 
     # >=100 cycles / >=45s: r3's 21-cycle/10.6s window was small enough
@@ -591,9 +617,11 @@ def main():
     cycles = 0
     if classic:
         run_cycle(trainer, config)  # warmup: compiles generate/score/train
+        warm_compiles = trainer._compile_ledger.total_compiles()
         warm = time.time()
         while cycles < min_cycles or (time.time() - warm) < min_seconds:
             run_cycle(trainer, config)
+            trainer._hbm.sample("cycle")
             if inject_s:
                 time.sleep(inject_s)
             cycles += 1
@@ -607,9 +635,11 @@ def main():
         # drain the warmup backlog COMPLETELY (train loss + the pre-
         # dispatched generate) so the timed window starts quiescent
         _ = jax.device_get((pending[2][0], pending[0][-1][1]["samples"]))
+        warm_compiles = trainer._compile_ledger.total_compiles()
         warm = time.time()
         while cycles < min_cycles or (time.time() - warm) < min_seconds:
             _, pending = trainer.pipelined_cycle(pending)
+            trainer._hbm.sample("cycle")
             if inject_s:
                 time.sleep(inject_s)
             cycles += 1
@@ -621,6 +651,12 @@ def main():
                 f"[bench] speculative scorer fell back "
                 f"{trainer.spec_fallbacks}x to the classic path\n"
             )
+
+    # snapshot NOW, before measure_phases: its A/B phases compile extra
+    # program variants on purpose, which are not timed-window retraces
+    timed_window_compiles = (
+        trainer._compile_ledger.total_compiles() - warm_compiles
+    )
 
     n_new = config.method.gen_kwargs["max_new_tokens"]
     n_prompt = N_PROMPT if not smoke else 16
@@ -712,6 +748,23 @@ def main():
             1.0 + accept_rate * spec_k_eff, 3)
     phase_json["decode_weights"] = (
         "int8_frozen_trunk" if int8 and trainer.split > 0 else "dense")
+
+    # compile/HBM forensics: per-fn compile counts, compiles that landed
+    # INSIDE the timed window (any nonzero = a retrace in steady state —
+    # bench_gate fails on any increase over the committed trajectory),
+    # and the measured device-memory watermark (overall + per phase)
+    hbm_snap = trainer._hbm.snapshot()["measured"]
+    phase_json["compiles"] = trainer._compile_ledger.counts()
+    phase_json["timed_window_compiles"] = timed_window_compiles
+    phase_json["peak_hbm_bytes"] = int(hbm_snap["peak_bytes"])
+    phase_json["phase_peak_hbm_bytes"] = {
+        k: int(v) for k, v in hbm_snap["per_phase_peak_bytes"].items()
+    }
+    if trainer._compile_ledger.total_storms():
+        sys.stderr.write(
+            "[bench] RETRACE STORMS: "
+            + json.dumps(trainer._compile_ledger.snapshot()["storms"]) + "\n"
+        )
 
     baseline = ESTIMATED_A100_SAMPLES_PER_SEC * NORTH_STAR_MULTIPLE
     print(json.dumps({

@@ -37,16 +37,38 @@ from typing import Any, Dict, List, Optional
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_TRAJECTORY = os.path.join(REPO, "BENCH_trajectory.json")
 
-# metric name -> (key in bench.py stdout JSON, max tolerated fractional
-# regression). 0.6 = fail only when the measured value drops below 40%
-# of the committed baseline: wide enough for shared-CPU CI jitter on the
-# ~0.1s smoke timing window, narrow enough to catch an injected
-# per-cycle stall or a lost overlap schedule (both cut smoke throughput
-# by >2x).
+# metric name -> spec dict:
+#   key            — key in bench.py's stdout JSON
+#   direction      — "higher_better" (throughput-style; fails when the
+#                    value drops more than `max_regression` below the
+#                    baseline) or "lower_better" (count-style; fails
+#                    when the value rises more than `max_increase`
+#                    above the baseline)
+#   max_regression — higher_better tolerance. 0.6 = fail only below 40%
+#                    of the committed baseline: wide enough for
+#                    shared-CPU CI jitter on the ~0.1s smoke timing
+#                    window, narrow enough to catch an injected
+#                    per-cycle stall or a lost overlap schedule (both
+#                    cut smoke throughput by >2x).
+#   max_increase   — lower_better tolerance. 0.0 = ANY increase fails
+#                    (a compile landing inside the timed window is a
+#                    retrace storm — deterministic, not CI noise); the
+#                    HBM watermark gets 50% headroom because the
+#                    live-arrays fallback on CPU CI jitters with GC
+#                    timing, while a leaked params copy doubles it.
+# Gating aggregates across --runs with best-of: max for higher_better,
+# min for lower_better (both absorb one-off CI hiccups).
 GATED_METRICS: Dict[str, Any] = {
-    "ppo_samples_per_sec_per_chip": ("value", 0.6),
-    "tokens_per_sec_per_chip": ("tokens_per_sec_per_chip", 0.6),
-    "mfu_estimate": ("mfu_estimate", 0.6),
+    "ppo_samples_per_sec_per_chip": {"key": "value", "max_regression": 0.6},
+    "tokens_per_sec_per_chip": {"key": "tokens_per_sec_per_chip",
+                                "max_regression": 0.6},
+    "mfu_estimate": {"key": "mfu_estimate", "max_regression": 0.6},
+    "timed_window_compiles": {"key": "timed_window_compiles",
+                              "direction": "lower_better",
+                              "max_increase": 0.0},
+    "peak_hbm_bytes": {"key": "peak_hbm_bytes",
+                       "direction": "lower_better",
+                       "max_increase": 0.5},
 }
 
 # a baseline below this is below the metric's own rounding granularity
@@ -72,12 +94,12 @@ def extract_metrics(bench_stdout: str) -> Dict[str, float]:
     if payload is None:
         raise ValueError("no JSON object found in bench output")
     out: Dict[str, float] = {}
-    for metric, (key, _tol) in GATED_METRICS.items():
-        if key in payload:
-            out[metric] = float(payload[key])
+    for metric, spec in GATED_METRICS.items():
+        if spec["key"] in payload:
+            out[metric] = float(payload[spec["key"]])
     if not out:
         raise ValueError(f"bench JSON carried none of the gated keys: "
-                         f"{sorted(k for k, _ in GATED_METRICS.values())}")
+                         f"{sorted(s['key'] for s in GATED_METRICS.values())}")
     return out
 
 
@@ -86,16 +108,35 @@ def compare(baseline: Dict[str, Any],
     """Diff `current` against the trajectory's `metrics` section; return
     one failure record per regressed metric (empty list = gate passes).
     A metric missing from either side is skipped — the gate only judges
-    what both sides measured. Higher is better for every gated metric."""
+    what both sides measured. higher_better metrics fail on a drop past
+    `max_regression`; lower_better (count-type) metrics fail on a rise
+    past `max_increase` — with a zero baseline (the steady state for
+    timed-window compiles), any nonzero measurement fails."""
     failures: List[Dict[str, Any]] = []
     base_metrics = baseline.get("metrics", {})
-    for metric, (_key, default_tol) in GATED_METRICS.items():
+    for metric, spec in GATED_METRICS.items():
         base = base_metrics.get(metric)
         if base is None or metric not in current:
             continue
         base_value = float(base["value"])
-        allowed = float(base.get("max_regression", default_tol))
         cur = current[metric]
+        direction = base.get("direction",
+                             spec.get("direction", "higher_better"))
+        if direction == "lower_better":
+            allowed = float(base.get("max_increase",
+                                     spec.get("max_increase", 0.0)))
+            ceiling = base_value * (1.0 + allowed)
+            if cur > ceiling:
+                failures.append({
+                    "metric": metric,
+                    "baseline": base_value,
+                    "current": cur,
+                    "direction": "lower_better",
+                    "allowed_max": round(ceiling, 4),
+                })
+            continue
+        allowed = float(base.get("max_regression",
+                                 spec.get("max_regression", 0.6)))
         if base_value < float(base.get("min_meaningful",
                                        MIN_MEANINGFUL_BASELINE)):
             sys.stderr.write(
@@ -142,14 +183,21 @@ def update_trajectory(path: str, current: Dict[str, float],
     traj = load_trajectory(path) or {"history": []}
     traj["cmd"] = ("JAX_PLATFORMS=cpu python bench.py"
                    + (" --smoke" if smoke else ""))
-    traj["metrics"] = {
-        metric: {
-            "value": current[metric],
-            "max_regression": GATED_METRICS[metric][1],
-            "direction": "higher_better",
-        }
-        for metric in current
-    }
+    traj["metrics"] = {}
+    for metric in current:
+        spec = GATED_METRICS[metric]
+        if spec.get("direction") == "lower_better":
+            traj["metrics"][metric] = {
+                "value": current[metric],
+                "max_increase": spec["max_increase"],
+                "direction": "lower_better",
+            }
+        else:
+            traj["metrics"][metric] = {
+                "value": current[metric],
+                "max_regression": spec["max_regression"],
+                "direction": "higher_better",
+            }
     traj.setdefault("history", []).append({
         "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "metrics": dict(current),
@@ -191,7 +239,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("BENCH GATE ERROR: every bench run failed")
         return 2
     current = {
-        metric: max(r[metric] for r in runs if metric in r)
+        metric: (min if GATED_METRICS[metric].get("direction")
+                 == "lower_better" else max)(
+            r[metric] for r in runs if metric in r)
         for metric in GATED_METRICS
         if any(metric in r for r in runs)
     }
@@ -210,9 +260,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     failures = compare(traj, current)
     if failures:
         for f in failures:
-            print(f"BENCH REGRESSION: {f['metric']} = {f['current']:g} "
-                  f"is {f['ratio']:.0%} of baseline {f['baseline']:g} "
-                  f"(allowed >= {f['allowed_min_ratio']:.0%})")
+            if f.get("direction") == "lower_better":
+                print(f"BENCH REGRESSION: {f['metric']} = {f['current']:g} "
+                      f"rose above baseline {f['baseline']:g} "
+                      f"(allowed <= {f['allowed_max']:g})")
+            else:
+                print(f"BENCH REGRESSION: {f['metric']} = {f['current']:g} "
+                      f"is {f['ratio']:.0%} of baseline {f['baseline']:g} "
+                      f"(allowed >= {f['allowed_min_ratio']:.0%})")
         return 1
     print(json.dumps({"bench_gate": "pass", "metrics": current,
                       "baseline": {k: v["value"]

@@ -1,0 +1,202 @@
+"""CI smoke: compile ledger + HBM ledger forensics on a short PPO run.
+
+A 2-cycle CPU PPO run with `train.tracing` on that must show:
+
+- every jitted function compiled during cycle 1, and cycle 2 compiling
+  NOTHING new (zero unexpected retraces, zero storms) — the steady-state
+  invariant the compile budgets in docs/observability.md declare;
+- the measured device-memory watermark staying under the analytic
+  budget from `trlx_tpu.observability.hbm.analytic_train_components`
+  plus a fixed-overhead allowance (at smoke scale the rollout buffers
+  and XLA scratch dominate the tiny param tree, hence the allowance —
+  on a real config the analytic side dominates);
+- the watermark and per-fn compile counts flowing into the drained
+  train stats (`compile/*`, `hbm/*`) and the goodput extras;
+- one INJECTED train-step shape churn (response width padded by 32)
+  firing exactly one retrace-storm postmortem bundle that names the
+  churned `response_tensors` leaf in its signature diff.
+
+Run from the repo root: JAX_PLATFORMS=cpu python scripts/compile_hbm_smoke.py
+"""
+
+import json
+import os
+import shutil
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from trlx_tpu.data.default_configs import default_ppo_config  # noqa: E402
+from trlx_tpu.observability import hbm as hbm_mod  # noqa: E402
+from trlx_tpu.pipeline import MiniBatchIterator  # noqa: E402
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline  # noqa: E402
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer  # noqa: E402
+from trlx_tpu.utils import set_seed  # noqa: E402
+
+MAX_NEW = 4
+SEQ = 32
+CHURN_PAD = 32
+# byte tokenizer: keep sampled ids printable so decode round-trips
+SUPPRESS = [i for i in range(259) if not (32 <= i < 127 or i == 258)]
+# fixed allowance on top of the analytic budget for smoke scale: jax/XLA
+# scratch buffers, the rollout store's host-pinned copies, and tokenizer
+# tables are all O(fixed) and dwarf a gpt2-tiny param tree
+OVERHEAD_BYTES = 256 << 20
+
+
+def build_config(workdir):
+    return default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=SEQ, batch_size=4, total_steps=4, tracker=None,
+                   checkpoint_dir=os.path.join(workdir, "ckpts"), seed=7,
+                   tracing=True,
+                   postmortem_dir=os.path.join(workdir, "postmortems")),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(max_new_tokens=MAX_NEW, do_sample=False,
+                                    suppress_tokens=SUPPRESS)),
+    )
+
+
+def one_cycle(trainer):
+    """Classic store path: make_experience + every ppo epoch. Returns
+    (final stats, first minibatch) — the minibatch feeds the churn
+    injection below."""
+    trainer.store.clear_history()
+    trainer.make_experience(trainer.config.method.num_rollouts)
+    stats = first_mb = None
+    for epoch in range(trainer.config.method.ppo_epochs):
+        loader = trainer.create_train_dataloader(seed_offset=epoch)
+        for minibatch in MiniBatchIterator(loader, trainer.mb_size,
+                                           trainer.num_mb):
+            if first_mb is None:
+                first_mb = minibatch
+            stats = trainer.train_minibatch(minibatch)
+    return stats, first_mb
+
+
+def n_leaves(tree):
+    return sum(int(np.prod(np.shape(x)))
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def main():
+    # stable location so CI can upload the postmortem bundle on failure
+    workdir = os.path.join(os.getcwd(), "logs", "compile_hbm_smoke")
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir)
+    set_seed(7)
+    config = build_config(workdir)
+    trainer = PPOTrainer(
+        config,
+        reward_fn=lambda samples, **kw: [float(len(s)) for s in samples],
+    )
+    pipeline = PromptPipeline(["hello world", "jax tpu", "ppo", "trace"] * 2,
+                              max_prompt_length=8,
+                              tokenizer=trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    ledger = trainer._compile_ledger
+    assert ledger is not None and trainer._hbm is not None, (
+        "train.tracing=True must wire the compile + HBM ledgers")
+
+    # ---- cycle 1: everything compiles -------------------------------
+    stats, mb = one_cycle(trainer)
+    counts1 = dict(ledger.counts())
+    assert any(counts1.values()), "cycle 1 compiled nothing"
+    assert ledger.total_storms() == 0, (
+        f"cycle 1 already stormed: {ledger.snapshot()['storms']}")
+
+    # ---- cycle 2: ZERO new compiles ---------------------------------
+    stats, _ = one_cycle(trainer)
+    counts2 = dict(ledger.counts())
+    unexpected = {k: (counts1.get(k, 0), v) for k, v in counts2.items()
+                  if v != counts1.get(k, 0)}
+    assert not unexpected, f"cycle 2 recompiled: {unexpected}"
+    assert ledger.total_storms() == 0, (
+        f"retrace storms in steady state: {ledger.snapshot()['storms']}")
+    loss = float(np.asarray(stats["losses"]["total_loss"]))
+    assert np.isfinite(loss), f"non-finite final loss {loss}"
+
+    # ---- watermark vs analytic budget -------------------------------
+    trainer._hbm.sample("smoke_end")
+    measured = trainer._hbm.snapshot()["measured"]
+    peak = int(measured["peak_bytes"])
+    assert peak > 0, "HBM ledger measured nothing"
+    comp = hbm_mod.analytic_train_components(
+        trainer.model_cfg,
+        n_params=n_leaves(trainer.train_params) + n_leaves(trainer.frozen_params),
+        n_trainable=n_leaves(trainer.train_params),
+        minibatch=trainer.mb_size,
+        seq_length=SEQ,
+        rollout_rows=config.method.chunk_size,
+    )
+    budget = comp["total_bytes"] + OVERHEAD_BYTES
+    assert peak <= budget, (
+        f"measured watermark {peak} above analytic budget "
+        f"{comp['total_bytes']} + {OVERHEAD_BYTES} overhead")
+
+    # ---- ledgers flow into the drained stats ------------------------
+    drained = {}
+    drained.update(ledger.drain_stats())
+    drained.update(trainer._hbm.drain_stats())
+    for key in ("compile/total", "compile/storms", "hbm/peak_bytes"):
+        assert key in drained, f"{key} missing from drained stats"
+    assert drained["compile/storms"] == 0.0
+
+    # ---- injected shape churn: exactly one storm postmortem ---------
+    batch = trainer.batch_to_device(mb[0])
+    padded = batch.replace(
+        response_tensors=jnp.pad(batch.response_tensors,
+                                 ((0, 0), (0, CHURN_PAD))),
+        logprobs=jnp.pad(batch.logprobs, ((0, 0), (0, CHURN_PAD))),
+        values=jnp.pad(batch.values, ((0, 0), (0, CHURN_PAD))),
+        rewards=jnp.pad(batch.rewards, ((0, 0), (0, CHURN_PAD))),
+    )
+    tp, opt, _ = trainer._train_step_fn(
+        trainer.train_params, trainer.frozen_params, trainer.opt_state,
+        padded, *trainer._sentinel_args(),
+    )
+    # the jit donates params/opt buffers; adopt the returned ones so the
+    # trainer object stays alive past the injection
+    trainer.train_params, trainer.opt_state = tp, opt
+
+    snap = ledger.snapshot()
+    storms = [s for s in snap["storms"] if s["fn"] == "train_step"]
+    assert len(storms) == 1, f"expected exactly 1 train_step storm: {storms}"
+    churned = [d["leaf"] for d in storms[0]["diff"]]
+    assert any("response_tensors" in leaf for leaf in churned), (
+        f"storm diff does not name the churned response leaf: {churned}")
+
+    pm_root = config.train.postmortem_dir
+    bundles = [d for d in os.listdir(pm_root) if "retrace-storm" in d]
+    assert len(bundles) == 1, (
+        f"expected exactly one retrace-storm bundle: {bundles}")
+    with open(os.path.join(pm_root, bundles[0], "trigger.json")) as f:
+        trig = json.load(f)
+    assert trig["trigger"] == "retrace-storm-train_step", trig["trigger"]
+    diff_leaves = [d["leaf"] for d in trig["detail"]["diff"]]
+    assert any("response_tensors" in leaf for leaf in diff_leaves), diff_leaves
+
+    print(json.dumps({
+        "compile_hbm_smoke": "pass",
+        "functions_compiled": sum(1 for v in counts2.values() if v),
+        "functions_declared": len(counts2),
+        "total_compiles": ledger.total_compiles(),
+        "steady_state_recompiles": 0,
+        "peak_hbm_bytes": peak,
+        "analytic_budget_bytes": comp["total_bytes"],
+        "watermark_source": measured["source"],
+        "injected_storm_leaves": churned,
+        "postmortem": os.path.join(pm_root, bundles[0]),
+        "final_loss": loss,
+    }))
+
+
+if __name__ == "__main__":
+    main()

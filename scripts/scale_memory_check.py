@@ -25,6 +25,14 @@ Caveats (documented in docs/parallelism.md):
 Reference envelope being matched: the reference demonstrably trained 6B
 (examples/hh/README.md:3-7, 8xA100 ZeRO-2) and configured TP=8 x PP=4
 (configs/nemo_configs/megatron_65b.yaml:49-50).
+
+The itemized analytic side (params + AdamW moments + grads + rollout KV
+cache) comes from `trlx_tpu.observability.hbm.analytic_train_components`
+— the same model the live `HBMLedger` uses at runtime (docs/
+observability.md "Device-memory ledger"), so a formula change moves the
+script and the in-process watermarks together. `analytic_budget(which)`
+exposes the per-device analytic total without compiling anything
+(scripts/compile_hbm_smoke.py uses it as the watermark ceiling).
 """
 
 import json
@@ -50,6 +58,91 @@ def _analysis_row(compiled):
         "alias_gib": round(an.alias_size_in_bytes / GiB, 2),
         "peak_gib": round(peak / GiB, 2),
     }
+
+
+def _analytic_section(cfg, n_params, n_trainable, minibatch, seq_length,
+                      rollout_rows, shard_ways, kv_dtype="float32"):
+    """Itemized analytic budget row from the shared hbm model, plus its
+    even-sharding per-device split (`shard_ways` = ways params/opt/grads
+    are sharded; replication across a data axis does not shrink the
+    per-device share)."""
+    from trlx_tpu.observability import hbm
+
+    comp = hbm.analytic_train_components(
+        cfg, n_params, n_trainable, minibatch=minibatch,
+        seq_length=seq_length, rollout_rows=rollout_rows,
+        kv_dtype=kv_dtype,
+    )
+    return {
+        **{k.replace("_bytes", "_gib"): round(v / GiB, 2)
+           for k, v in comp.items()},
+        "shard_ways": shard_ways,
+        "per_device_total_bytes": comp["total_bytes"] // shard_ways,
+        "per_device_total_gib": round(comp["total_bytes"] / shard_ways / GiB, 2),
+    }
+
+
+def analytic_budget(which="gptj_6b_fsdp"):
+    """Per-device analytic byte budget for a flagship config, computed
+    from `trlx_tpu.observability.hbm` WITHOUT compiling anything (an
+    eval_shape probe only — safe on any host). Returns the
+    `_analytic_section` dict; `per_device_total_bytes` is the ceiling
+    scripts/compile_hbm_smoke.py holds measured watermarks against."""
+    import jax
+    import jax.numpy as jnp
+
+    import trlx_tpu  # noqa: F401
+    import trlx_tpu.trainer.ppo_trainer  # noqa: F401  (registers PPOConfig)
+    from trlx_tpu.data.configs import TRLConfig
+    from trlx_tpu.models import resolve_transformer_config
+
+    yml = {"gptj_6b_fsdp": "ppo_gptj_6b_fsdp.yml",
+           "llama_7b_tp_pp": "ppo_llama_7b_tp_pp.yml"}[which]
+    config = TRLConfig.load_yaml(os.path.join(REPO, "configs", yml))
+    T = config.train.seq_length
+
+    def _n(tree):
+        return sum(
+            int(jnp.prod(jnp.asarray(l.shape)))
+            for l in jax.tree_util.tree_leaves(tree)
+        )
+
+    tok1 = jax.ShapeDtypeStruct((1, T), jnp.int32)
+    if which == "gptj_6b_fsdp":
+        from trlx_tpu.models import CausalLMWithValueHead, trainable_mask
+        from trlx_tpu.trainer.base_trainer import partition_params
+
+        cfg = resolve_transformer_config(config.model, vocab_size=259)
+        model = CausalLMWithValueHead(cfg)
+        params_abs = jax.eval_shape(
+            model.init, jax.random.PRNGKey(0), tok1, tok1
+        )["params"]
+        mask = trainable_mask(params_abs, cfg, config.model.num_layers_unfrozen)
+        train_abs, _ = partition_params(params_abs, mask)
+        return _analytic_section(
+            cfg, _n(params_abs), _n(train_abs),
+            minibatch=config.train.minibatch_size or config.train.batch_size,
+            seq_length=T, rollout_rows=config.method.chunk_size,
+            shard_ways=config.parallel.fsdp,
+        )
+    from trlx_tpu.models import TransformerLM
+
+    cfg = resolve_transformer_config(config.model, vocab_size=32000)
+    model = TransformerLM(cfg)
+    params_abs = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), tok1, tok1
+    )["params"]
+    # pipelined_mixin.make_trainable_mask semantics: blocks + final
+    # norm / untied lm_head train, embeddings freeze
+    n_trainable = sum(
+        _n(v) for k, v in params_abs.items() if k not in ("wte", "wpe")
+    )
+    par = config.parallel
+    return _analytic_section(
+        cfg, _n(params_abs), n_trainable,
+        minibatch=config.train.batch_size, seq_length=T,
+        rollout_rows=0, shard_ways=par.pipeline * par.tensor,
+    )
 
 
 def check_gptj_6b_fsdp(minibatch_size=None):
@@ -168,6 +261,10 @@ def check_gptj_6b_fsdp(minibatch_size=None):
     )
     decode_row = _analysis_row(compiled_dec)
 
+    n_trainable = sum(
+        int(jnp.prod(jnp.asarray(l.shape)))
+        for l in jax.tree_util.tree_leaves(train_abs)
+    )
     return {
         "config": "ppo_gptj_6b_fsdp.yml",
         "mesh": {"data": 1, "fsdp": 8},
@@ -175,6 +272,10 @@ def check_gptj_6b_fsdp(minibatch_size=None):
         "minibatch": B,
         "train_step": train_row,
         "decode_step": decode_row,
+        "analytic": _analytic_section(
+            cfg, n_params, n_trainable, minibatch=B, seq_length=T,
+            rollout_rows=chunk, shard_ways=config.parallel.fsdp,
+        ),
     }
 
 
@@ -284,6 +385,10 @@ def check_llama_7b_tp_pp():
         .lower(train_abs, frozen_abs, opt_abs, batch_abs)
         .compile()
     )
+    n_trainable = sum(
+        int(jnp.prod(jnp.asarray(l.shape)))
+        for l in jax.tree_util.tree_leaves(train_abs)
+    )
     return {
         "config": "ppo_llama_7b_tp_pp.yml",
         "mesh": {"data": par.data, "pipe": par.pipeline, "tensor": par.tensor},
@@ -293,6 +398,10 @@ def check_llama_7b_tp_pp():
         "n_microbatches": M,
         "dtype": "float32 (CPU-backend constraint; bf16 on TPU is ~2x smaller temps)",
         "train_step": _analysis_row(compiled),
+        "analytic": _analytic_section(
+            cfg, n_params, n_trainable, minibatch=B, seq_length=T,
+            rollout_rows=0, shard_ways=par.pipeline * par.tensor,
+        ),
     }
 
 

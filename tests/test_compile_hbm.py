@@ -1,0 +1,363 @@
+"""Compile & HBM forensics tests (ISSUE 18 acceptance pins).
+
+- retrace-storm detection attributes the recompile to the exact churned
+  argument leaf (signature diff), dumps a postmortem once per function;
+- per-function compile counts are stable across repeated same-shape
+  calls, and a trainer's second PPO cycle compiles NOTHING new;
+- the flag-off pin: `ledgered_jit(..., ledger=None)` is plain `jax.jit`
+  and a tracing-off trainer produces bitwise identical losses to a
+  tracing-on one;
+- signature capture + HBM sampling are donated-buffer safe;
+- the OOM postmortem bundle carries ledger snapshot, compile history,
+  and evaluated context callables, and fires exactly once per site;
+- the analytic HBM model agrees with scripts/scale_memory_check.py's
+  itemization and with the engine's paged KV accounting formula;
+- `train.compilation_cache_dir` wires the JAX persistent cache.
+"""
+
+import importlib.util
+import json
+import os
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trlx_tpu.data.default_configs import default_ppo_config
+from trlx_tpu.observability import (
+    CompileLedger,
+    HBMLedger,
+    arg_signature,
+    is_oom_error,
+    kv_arena_bytes,
+    ledgered_jit,
+    oom_postmortem,
+    postmortem,
+    signature_diff,
+)
+from trlx_tpu.observability import hbm as hbm_mod
+from trlx_tpu.pipeline import MiniBatchIterator
+from trlx_tpu.pipeline.offline_pipeline import PromptPipeline
+from trlx_tpu.trainer.ppo_trainer import PPOTrainer
+
+MAX_NEW = 4
+SUPPRESS = [i for i in range(259) if not (32 <= i < 127 or i == 258)]
+GEN = dict(max_new_tokens=MAX_NEW, do_sample=False, suppress_tokens=SUPPRESS)
+PROMPTS = ["hello world", "jax tpu", "ppo", "trace"] * 2
+
+REWARD_FN = lambda samples, **kw: [float(len(s)) for s in samples]  # noqa: E731
+
+
+@pytest.fixture(autouse=True)
+def _fresh_triggers():
+    postmortem.reset_triggers()
+    yield
+    postmortem.reset_triggers()
+
+
+def _config(tmp_path, tracing=True, **train_over):
+    return default_ppo_config().evolve(
+        model=dict(model_path="random:gpt2-tiny", num_layers_unfrozen=1,
+                   model_extra_configs={"dtype": "float32"}),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=32, batch_size=4, total_steps=4, tracker=None,
+                   checkpoint_dir=str(tmp_path), seed=11, tracing=tracing,
+                   postmortem_dir=str(tmp_path / "pm"), **train_over),
+        method=dict(num_rollouts=8, chunk_size=4, ppo_epochs=2,
+                    gen_kwargs=dict(GEN)),
+    )
+
+
+def _trainer(tmp_path, tracing=True, **train_over):
+    trainer = PPOTrainer(_config(tmp_path, tracing=tracing, **train_over),
+                         reward_fn=REWARD_FN)
+    pipeline = PromptPipeline(PROMPTS, max_prompt_length=8,
+                              tokenizer=trainer.tokenizer)
+    trainer.add_prompt_pipeline(pipeline)
+    return trainer
+
+
+def _one_cycle(trainer):
+    """Classic store path: make_experience + every ppo epoch; returns the
+    final minibatch stats."""
+    trainer.store.clear_history()
+    trainer.make_experience(trainer.config.method.num_rollouts)
+    stats = None
+    for epoch in range(trainer.config.method.ppo_epochs):
+        loader = trainer.create_train_dataloader(seed_offset=epoch)
+        for minibatch in MiniBatchIterator(loader, trainer.mb_size,
+                                           trainer.num_mb):
+            stats = trainer.train_minibatch(minibatch)
+    return stats
+
+
+# ----------------------------------------------------------------------
+# Retrace-storm detection (unit level)
+# ----------------------------------------------------------------------
+
+
+def test_retrace_storm_names_offending_leaf(tmp_path):
+    ledger = CompileLedger(postmortem_dir=str(tmp_path / "pm"))
+    f = ledger.jit(lambda x: x * 2, "doubler", budget=1)
+    f(jnp.ones(4))
+    assert ledger.counts()["doubler"] == 1
+    assert ledger.total_storms() == 0
+
+    f(jnp.ones(8))  # shape churn: second program for a budget-1 fn
+    snap = ledger.snapshot()
+    assert snap["functions"]["doubler"]["compiles"] == 2
+    assert snap["functions"]["doubler"]["over_budget"]
+    assert len(snap["storms"]) == 1
+    storm = snap["storms"][0]
+    assert storm["fn"] == "doubler"
+    assert storm["cause"] == "argument signature churn"
+    assert storm["diff"] == [
+        {"leaf": "[0][0]", "before": "float32[4]", "after": "float32[8]"}
+    ]
+    # postmortem bundle written, naming the offending leaf
+    pm_root = tmp_path / "pm"
+    bundles = list(pm_root.iterdir())
+    assert len(bundles) == 1
+    trig = json.loads((bundles[0] / "trigger.json").read_text())
+    assert trig["detail"]["diff"][0]["leaf"] == "[0][0]"
+
+    f(jnp.ones(16))  # third program: storms accrue, postmortem does not
+    assert ledger.total_storms() == 2
+    assert len(list(pm_root.iterdir())) == 1
+
+
+def test_compile_count_stable_across_same_shape_calls():
+    ledger = CompileLedger()
+    f = ledger.jit(lambda x: x + 1, "inc")
+    for _ in range(5):
+        f(jnp.arange(3.0))
+    rec = ledger.snapshot()["functions"]["inc"]
+    assert rec["compiles"] == 1 and rec["calls"] == 5
+    assert ledger.total_storms() == 0
+    stats = ledger.drain_stats()
+    assert stats["compile/total"] == 1.0
+    assert stats["compile/storms"] == 0.0
+
+
+def test_dtype_and_structure_churn_in_diff():
+    prev = arg_signature((jnp.ones(4, jnp.float32),), {})
+    cur = arg_signature((jnp.ones(4, jnp.bfloat16),), {})
+    d = signature_diff(prev, cur)
+    assert d == [{"leaf": "[0][0]", "before": "float32[4]",
+                  "after": "bfloat16[4]"}]
+    # a leaf disappearing (e.g. None-ed optional field) shows as after=None
+    gone = signature_diff(prev, arg_signature((), {}))
+    assert gone == [{"leaf": "[0][0]", "before": "float32[4]", "after": None}]
+
+
+# ----------------------------------------------------------------------
+# Flag-off pin
+# ----------------------------------------------------------------------
+
+
+def test_ledgered_jit_off_is_plain_jax_jit():
+    fn = lambda x: x * 3 + 1  # noqa: E731
+    off = ledgered_jit(fn, name="triple", ledger=None)
+    plain = jax.jit(fn)
+    assert type(off) is type(plain)
+    assert not hasattr(off, "_ledgered")
+    x = jnp.asarray(np.random.default_rng(0).normal(size=17),
+                    dtype=jnp.float32)
+    ledger = CompileLedger()
+    on = ledgered_jit(fn, name="triple", ledger=ledger)
+    assert (np.asarray(off(x)).tobytes()
+            == np.asarray(on(x)).tobytes()
+            == np.asarray(plain(x)).tobytes())
+    assert ledger.counts()["triple"] == 1
+
+
+def test_trainer_tracing_off_vs_on_bitwise_identical(tmp_path):
+    losses = {}
+    for tracing in (False, True):
+        trainer = _trainer(tmp_path / str(tracing), tracing=tracing)
+        assert (trainer._compile_ledger is not None) is tracing
+        assert (trainer._hbm is not None) is tracing
+        stats = _one_cycle(trainer)
+        losses[tracing] = np.asarray(
+            stats["losses"]["total_loss"]).tobytes()
+    assert losses[False] == losses[True]
+
+
+# ----------------------------------------------------------------------
+# Trainer-level stability + stats surfacing
+# ----------------------------------------------------------------------
+
+
+def test_trainer_second_cycle_compiles_nothing(tmp_path):
+    trainer = _trainer(tmp_path, tracing=True)
+    _one_cycle(trainer)
+    after_first = dict(trainer._compile_ledger.counts())
+    assert after_first, "cycle 1 must register jitted functions"
+    _one_cycle(trainer)
+    assert trainer._compile_ledger.counts() == after_first
+    assert trainer._compile_ledger.total_storms() == 0
+    # measured watermark flows into the hbm ledger + prometheus text
+    trainer._hbm.sample("test")
+    snap = trainer._hbm.snapshot()
+    assert snap["measured"]["peak_bytes"] > 0
+    prom = trainer._hbm.render_prometheus()
+    assert "trlx_tpu_hbm_peak_bytes" in prom
+    prom_c = trainer._compile_ledger.render_prometheus()
+    assert "trlx_tpu_compiles_total" in prom_c
+
+
+# ----------------------------------------------------------------------
+# Donation safety
+# ----------------------------------------------------------------------
+
+
+def test_signature_and_sampling_survive_donated_buffers():
+    ledger = CompileLedger()
+    hbm = HBMLedger()
+    f = ledger.jit(lambda x: x * 2, "donated", donate_argnums=(0,))
+    x = jnp.ones(64)
+    f(x)
+    assert x.is_deleted()
+    # signature was computed from metadata, which donation preserves
+    sig = ledger.snapshot()["functions"]["donated"]["last_signature"]
+    assert [list(leaf) for leaf in sig] == [["[0][0]", "float32[64]"]]
+    # live-array enumeration skips the donated (deleted) buffer
+    assert hbm.sample("after_donation") >= 0
+    y = jnp.ones(64)
+    f(y)  # same shape: no recompile
+    assert ledger.counts()["donated"] == 1
+
+
+# ----------------------------------------------------------------------
+# OOM postmortem
+# ----------------------------------------------------------------------
+
+
+def test_oom_postmortem_once_per_site_full_bundle(tmp_path):
+    exc = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                       "to allocate 17179869184 bytes")
+    assert is_oom_error(exc)
+    assert not is_oom_error(ValueError("shape mismatch"))
+
+    hbm = HBMLedger()
+    hbm.set_component("params", 1 << 20, dtype="float32")
+    ledger = CompileLedger()
+    ledger.jit(lambda x: x + 1, "step")(jnp.ones(4))
+
+    path = oom_postmortem(
+        "train_step", exc, hbm=hbm, compile_ledger=ledger,
+        context={"kv_stats": lambda: {"blocks_used": 3},
+                 "dead_engine": lambda: 1 / 0,
+                 "iter_count": 7},
+        config={"train": {"seed": 11}},
+        out_dir=str(tmp_path),
+    )
+    assert path is not None
+    trig = json.loads(open(os.path.join(path, "trigger.json")).read())
+    detail = trig["detail"]
+    assert detail["site"] == "train_step"
+    assert "RESOURCE_EXHAUSTED" in detail["error"]
+    assert detail["hbm"]["analytic"]["components"]["params"]["bytes"] == 1 << 20
+    assert detail["compile"]["functions"]["step"]["compiles"] == 1
+    assert detail["kv_stats"] == {"blocks_used": 3}
+    assert detail["dead_engine"].startswith("<unavailable:")
+    assert detail["iter_count"] == 7
+    assert isinstance(detail["largest_live_buffers"], list)
+    assert json.loads(
+        open(os.path.join(path, "config.json")).read()
+    )["train"]["seed"] == 11
+    # once per site: a second OOM at the same site does not dump again
+    assert oom_postmortem("train_step", exc, out_dir=str(tmp_path)) is None
+    # a different site still fires
+    assert oom_postmortem("engine.step", exc, out_dir=str(tmp_path)) is not None
+
+
+# ----------------------------------------------------------------------
+# Analytic model agreement
+# ----------------------------------------------------------------------
+
+
+def _fake_cfg(n_layers=2, kv_heads=4, head_dim=8):
+    return types.SimpleNamespace(n_layers=n_layers, kv_heads=kv_heads,
+                                 head_dim=head_dim)
+
+
+def test_kv_arena_formula_matches_engine_accounting():
+    """kv_arena_bytes must equal the paged pool's K+V block storage:
+    2 (K and V) x layers x blocks x block_size x kv_heads x head_dim x
+    itemsize, plus the f32 scale planes under int8."""
+    cfg = _fake_cfg()
+    n_blocks, block = 16, 32
+    f32 = kv_arena_bytes(cfg.n_layers, cfg.kv_heads, cfg.head_dim,
+                         n_blocks, block, dtype="float32")
+    assert f32 == 2 * cfg.n_layers * n_blocks * block * cfg.kv_heads * cfg.head_dim * 4
+    i8 = kv_arena_bytes(cfg.n_layers, cfg.kv_heads, cfg.head_dim,
+                        n_blocks, block, dtype="int8")
+    scale_planes = 2 * cfg.n_layers * n_blocks * block * cfg.kv_heads * 4
+    assert i8 == f32 // 4 + scale_planes
+
+
+def test_scale_check_analytic_section_agrees_with_hbm(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "scale_memory_check",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "scale_memory_check.py"),
+    )
+    smc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(smc)
+
+    cfg = _fake_cfg(n_layers=4, kv_heads=8, head_dim=16)
+    comp = hbm_mod.analytic_train_components(
+        cfg, n_params=1_000_000, n_trainable=250_000, minibatch=8,
+        seq_length=512, rollout_rows=16,
+    )
+    assert comp["params_bytes"] == 4_000_000
+    assert comp["optimizer_bytes"] == 2 * 4 * 250_000
+    assert comp["grads_bytes"] == 4 * 250_000
+    assert comp["kv_cache_bytes"] == hbm_mod.kv_cache_bytes(
+        4, 8, 16, 16, 512, "float32")
+    assert comp["total_bytes"] == sum(
+        v for k, v in comp.items() if k != "total_bytes")
+
+    row = smc._analytic_section(cfg, 1_000_000, 250_000, minibatch=8,
+                                seq_length=512, rollout_rows=16,
+                                shard_ways=4)
+    assert row["per_device_total_bytes"] == comp["total_bytes"] // 4
+    GiB = 1024 ** 3
+    assert row["params_gib"] == round(comp["params_bytes"] / GiB, 2)
+    assert row["total_gib"] == round(comp["total_bytes"] / GiB, 2)
+
+
+def test_hbm_ledger_analytic_vs_measured_split():
+    hbm = HBMLedger(capacity_bytes=1 << 30)
+    hbm.set_component("params", 100 << 20)
+    hbm.set_component("kv_arena", 50 << 20, blocks=16)
+    assert hbm.analytic_total() == 150 << 20
+    snap = hbm.snapshot()
+    assert snap["analytic"]["headroom_bytes"] == (1 << 30) - (150 << 20)
+    keep = jnp.ones(1024)  # ensure at least one live buffer to measure
+    hbm.sample("phase_a")
+    assert snap["measured"]["peak_bytes"] == 0  # snapshot predates sample
+    assert hbm.snapshot()["measured"]["peak_bytes"] > 0
+    del keep
+    stats = hbm.drain_stats()
+    assert stats["hbm/analytic_bytes"] == float(150 << 20)
+    assert stats["hbm/peak_bytes"] > 0
+
+
+# ----------------------------------------------------------------------
+# Persistent compilation cache knob
+# ----------------------------------------------------------------------
+
+
+def test_compilation_cache_dir_knob_wires_jax_config(tmp_path):
+    cache_dir = str(tmp_path / "xla_cache")
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        _trainer(tmp_path, tracing=False,
+                 compilation_cache_dir=cache_dir)
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)

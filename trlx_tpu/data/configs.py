@@ -454,6 +454,17 @@ class TrainConfig:
     # logs/traces (under logging_dir when set).
     trace_dir: Optional[str] = None
     postmortem_dir: str = "logs/postmortems"
+    # Opt-in JAX persistent compilation cache: compiled programs are
+    # written under this directory and reloaded on the next run, so
+    # repeat smokes of an unchanged config stop paying warm-up compiles.
+    # Hits/misses surface through the compile ledger (`compile/cache_*`
+    # stats) when `tracing` is on. None (default) leaves the cache off.
+    compilation_cache_dir: Optional[str] = None
+    # Per-function recompile budgets layered over the wrap sites'
+    # declared defaults (observability/compile_ledger.py): a function
+    # compiled more than its budget fires a retrace-storm postmortem.
+    # Only read when `tracing` is on.
+    compile_budgets: Dict[str, int] = field(default_factory=dict)
 
     # Generation shape buckets: round generate batches up to multiples of
     # 8 rows / 32 prompt columns (masked padding, outputs trimmed back)

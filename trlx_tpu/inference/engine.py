@@ -121,7 +121,18 @@ class InferenceEngine:
         prefix_cache_capacity: int = 0,
         multi_tenant: bool = False,
         adapter_store=None,
+        compile_ledger=None,
+        hbm_ledger=None,
     ):
+        # observability context objects (inference.tracing): the compile
+        # ledger wraps every engine jit below (decode budget 1 — the "no
+        # recompile" invariant, finally enforced); the HBM ledger gets
+        # the KV arena's analytic bytes and is sampled at dispatch
+        # boundaries. Both None by default: off = plain jax.jit, bitwise
+        # identical programs.
+        self.compile_ledger = compile_ledger
+        self.hbm = hbm_ledger
+        self._step_n = 0
         if getattr(model_cfg, "is_seq2seq", False):
             raise NotImplementedError(
                 "the continuous-batching engine serves causal LMs only"
@@ -298,6 +309,21 @@ class InferenceEngine:
         self._insert_fns: Dict[int, Callable] = {}
         self._paged_insert_fns: Dict[Tuple[int, int], Callable] = {}
         self._decode_fn = self._make_spec_decode() if self.spec_k > 0 else self._make_decode()
+        if self.hbm is not None and self.kv_paging:
+            stats = self.kv_stats()
+            self.hbm.set_component(
+                "kv_arena", stats["kv_pool_bytes"],
+                n_blocks=self._n_blocks, block_size=self.kv_block_size,
+                dtype=str(jnp.dtype(self.kv_cache_dtype)),
+            )
+
+    def _ljit(self, fn, name: str, budget: int = 1, **jit_kwargs):
+        """Engine jit entry point — plain jax.jit when no compile ledger
+        is attached (identical programs), ledgered otherwise."""
+        from trlx_tpu.observability.compile_ledger import ledgered_jit
+
+        return ledgered_jit(fn, name=name, budget=budget,
+                            ledger=self.compile_ledger, **jit_kwargs)
 
     # ------------------------------------------------------------------
     # Params (checkpoint hot-reload)
@@ -401,7 +427,8 @@ class InferenceEngine:
                 logits, new_cache = out[0], out[-1]
                 return logits[:, -1].astype(jnp.float32), new_cache
 
-            self._prefill_fns[key] = jax.jit(prefill)
+            self._prefill_fns[key] = self._ljit(
+                prefill, f"engine.prefill[b{pb},p{plen}]")
         return self._prefill_fns[key]
 
     def _get_insert(self, pb: int) -> Callable:
@@ -447,7 +474,8 @@ class InferenceEngine:
 
             # donate the old pool (the scatter aliases it); the prefill
             # cache can't alias (different leading dim), so it isn't listed
-            self._insert_fns[pb] = jax.jit(insert, donate_argnums=(0,))
+            self._insert_fns[pb] = self._ljit(
+                insert, f"engine.insert[b{pb}]", donate_argnums=(0,))
         return self._insert_fns[pb]
 
     def _get_paged_insert(self, pb: int, plen: int) -> Callable:
@@ -521,7 +549,9 @@ class InferenceEngine:
                     new_pool["adapter"] = pool["adapter"].at[slot_ids].set(aidx)
                 return new_pool
 
-            self._paged_insert_fns[key] = jax.jit(insert, donate_argnums=(0,))
+            self._paged_insert_fns[key] = self._ljit(
+                insert, f"engine.paged_insert[b{pb},p{plen}]",
+                donate_argnums=(0,))
         return self._paged_insert_fns[key]
 
     @staticmethod
@@ -533,7 +563,7 @@ class InferenceEngine:
         ids, max_new = row
         return ids, max_new, None
 
-    def insert_requests(
+    def _insert_requests_impl(
         self,
         rows: Sequence[Tuple],  # (unpadded prompt ids, max_new[, adapter_id])
         slot_ids: Sequence[int],
@@ -909,7 +939,7 @@ class InferenceEngine:
             }
             return new_pool, token, logprob, valid, finished
 
-        return jax.jit(decode, donate_argnums=(1,))
+        return self._ljit(decode, "engine.decode", donate_argnums=(1,))
 
     def _make_spec_decode(self) -> Callable:
         """Speculative slot decode: one call emits the slot's pending
@@ -1089,9 +1119,56 @@ class InferenceEngine:
             }
             return new_pool, emit_mat, lp_mat, valid_mat, finished
 
-        return jax.jit(decode, donate_argnums=(1,))
+        return self._ljit(decode, "engine.spec_decode", donate_argnums=(1,))
+
+    def _maybe_oom_postmortem(self, site: str, exc: BaseException) -> None:
+        """OOM forensics at the engine-dispatch boundary: RESOURCE_EXHAUSTED
+        escaping a prefill/insert/decode dispatch dumps a memory postmortem
+        (KV occupancy, sessions, resident adapters, compile history,
+        largest live buffers) once per site before re-raising."""
+        from trlx_tpu.observability.hbm import is_oom_error, oom_postmortem
+
+        if not is_oom_error(exc):
+            return
+        oom_postmortem(
+            site, exc, hbm=self.hbm, compile_ledger=self.compile_ledger,
+            context={
+                "kv_stats": self.kv_stats,
+                "session_stats": self.session_stats,
+                "adapter_stats": self.adapter_stats,
+                "active_slots": lambda: self.active_slots,
+                "num_slots": self.num_slots,
+            },
+        )
+
+    def insert_requests(self, *args, **kwargs) -> None:
+        """OOM-guarded wrapper over `_insert_requests_impl` (see there for
+        the contract); samples the HBM ledger at the prefill boundary."""
+        try:
+            self._insert_requests_impl(*args, **kwargs)
+        except Exception as e:
+            self._maybe_oom_postmortem("engine.insert", e)
+            raise
+        if self.hbm is not None:
+            self.hbm.sample("engine.insert")
 
     def step(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """OOM-guarded wrapper over `_step_impl` (see there for the return
+        contract); samples the HBM ledger every 64th decode step — often
+        enough to catch the arena high-water mark, rare enough to stay off
+        the hot path."""
+        try:
+            out = self._step_impl()
+        except Exception as e:
+            self._maybe_oom_postmortem("engine.step", e)
+            raise
+        if self.hbm is not None:
+            self._step_n += 1
+            if self._step_n % 64 == 1:
+                self.hbm.sample("engine.decode")
+        return out
+
+    def _step_impl(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Advance every active slot. Plain mode returns host arrays
         (tokens [P], logprobs [P] f32, emitted [P] bool, finished [P]
         bool); speculative mode returns (tokens [P, spec_k+1], logprobs
@@ -1206,17 +1283,17 @@ class InferenceEngine:
         paging is off."""
         if not self.kv_paging:
             return {}
+        # single source of truth for arena bytes (incl. int8 scale
+        # planes): observability/hbm.py — the same function the offline
+        # budget checker and the live HBM ledger price the arena with
+        from trlx_tpu.observability.hbm import kv_arena_bytes
+
         cfg = self.model_cfg
-        itemsize = jnp.dtype(self.kv_cache_dtype).itemsize
-        kv_bytes = (
-            2 * cfg.n_layers * self._n_blocks * self.kv_block_size
-            * cfg.kv_heads * cfg.head_dim * itemsize
+        kv_bytes = kv_arena_bytes(
+            cfg.n_layers, cfg.kv_heads, cfg.head_dim,
+            self._n_blocks, self.kv_block_size,
+            dtype=jnp.dtype(self.kv_cache_dtype),
         )
-        if self.kv_cache_dtype == jnp.int8:  # f32 scale planes
-            kv_bytes += (
-                2 * cfg.n_layers * self._n_blocks * self.kv_block_size
-                * cfg.kv_heads * 4
-            )
         with self._kv_lock:
             pool = self._block_pool
             return {

@@ -125,6 +125,13 @@ class Replica:
         # replicas) — dispatch prefers a replica already holding the
         # request's adapter so the hot path never waits on a disk load
         self.adapters: List[str] = []
+        # compile/HBM forensics from the last probe (None on replicas
+        # running with tracing off) — supervisors export these so a
+        # retrace storm or memory creep on one replica is visible
+        # fleet-wide without per-replica /metrics scrapes
+        self.compiles_total: Optional[int] = None
+        self.compile_storms: Optional[int] = None
+        self.hbm_peak_bytes: Optional[int] = None
 
     @property
     def breaker(self) -> resilience.CircuitBreaker:
@@ -144,6 +151,9 @@ class Replica:
             "last_error": self.last_error,
             "kv": dict(self.kv),
             "adapters": list(self.adapters),
+            "compiles_total": self.compiles_total,
+            "compile_storms": self.compile_storms,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
         }
 
 
@@ -282,6 +292,17 @@ class ReplicaRouter:
         rep.adapters = (
             list(adapters.get("resident") or [])
             if isinstance(adapters, dict) else []
+        )
+        comp = info.get("compile")
+        if isinstance(comp, dict):
+            rep.compiles_total = int(comp.get("total_compiles") or 0)
+            rep.compile_storms = len(comp.get("storms") or ())
+        else:
+            rep.compiles_total = rep.compile_storms = None
+        hbm = info.get("hbm")
+        rep.hbm_peak_bytes = (
+            int((hbm.get("measured") or {}).get("peak_bytes") or 0)
+            if isinstance(hbm, dict) else None
         )
         rep.last_probe = time.monotonic()
         rep.last_error = None
@@ -814,6 +835,23 @@ class ReplicaRouter:
             lines.append(f"# TYPE {ns}_{name}_total counter")
             for rep in rows:
                 lines.append(f'{ns}_{name}_total{{url="{rep.url}"}} {rep.kv[key]}')
+        # compile/HBM forensics, only for replicas probed with tracing on
+        forensics = (
+            ("replica_compiles", "compiles_total", "counter"),
+            ("replica_compile_storms", "compile_storms", "counter"),
+            ("replica_hbm_peak_bytes", "hbm_peak_bytes", "gauge"),
+        )
+        for name, attr, kind in forensics:
+            rows = [r for r in replicas if getattr(r, attr) is not None]
+            if not rows:
+                continue
+            suffix = "_total" if kind == "counter" else ""
+            lines.append(f"# TYPE {ns}_{name}{suffix} {kind}")
+            for rep in rows:
+                lines.append(
+                    f'{ns}_{name}{suffix}{{url="{rep.url}"}} '
+                    f"{getattr(rep, attr)}"
+                )
         text = "\n".join(lines) + "\n" + self.slo.render_prometheus(ns=ns)
         return dedupe_metadata(text)
 

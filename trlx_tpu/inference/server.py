@@ -917,9 +917,13 @@ class InferenceServer:
                     return
                 if path == "/metrics":
                     server.slo.ingest_registry(server.metrics)
+                    ledger = getattr(server.engine, "compile_ledger", None)
+                    hbm = getattr(server.engine, "hbm", None)
                     text = dedupe_metadata(
                         server.metrics.render()
                         + server.slo.render_prometheus(ns="trlx_tpu_inference")
+                        + (ledger.render_prometheus() if ledger is not None else "")
+                        + (hbm.render_prometheus() if hbm is not None else "")
                     )
                     self._reply(
                         200, text.encode(),
@@ -980,6 +984,20 @@ class InferenceServer:
                                 "capacity": store.capacity,
                             }}
                             if store is not None else {}
+                        ),
+                        # compile/HBM forensics (tracing on only) — per-fn
+                        # recompile counts and device-memory watermarks so
+                        # supervisors can spot retrace storms and memory
+                        # drift without scraping Prometheus
+                        **(
+                            {"compile": server.engine.compile_ledger.snapshot()}
+                            if getattr(server.engine, "compile_ledger", None)
+                            is not None else {}
+                        ),
+                        **(
+                            {"hbm": server.engine.hbm.snapshot()}
+                            if getattr(server.engine, "hbm", None)
+                            is not None else {}
                         ),
                     })
                     return

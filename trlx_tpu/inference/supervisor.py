@@ -211,6 +211,11 @@ class _Seat:
         self.crash_at: Optional[float] = None  # fault injection
         self.respawns = 0
         self.last_reason: Optional[str] = None
+        # compile/HBM forensics from the last probe (None with tracing
+        # off) — lets the supervisor status show a retrace storm or
+        # memory watermark creep per seat
+        self.compile_storms: Optional[int] = None
+        self.hbm_peak_bytes: Optional[int] = None
 
     def snapshot(self) -> Dict[str, Any]:
         return {
@@ -222,6 +227,8 @@ class _Seat:
             "respawns": self.respawns,
             "deaths": len(self.death_times),
             "last_reason": self.last_reason,
+            "compile_storms": self.compile_storms,
+            "hbm_peak_bytes": self.hbm_peak_bytes,
         }
 
 
@@ -525,6 +532,15 @@ class FleetSupervisor:
         step = info.get("checkpoint_step")
         seat.checkpoint_step = int(step) if step is not None else None
         seat.ready = bool(info.get("ready", info.get("status") == "ok"))
+        comp = info.get("compile")
+        seat.compile_storms = (
+            len(comp.get("storms") or ()) if isinstance(comp, dict) else None
+        )
+        hbm = info.get("hbm")
+        seat.hbm_peak_bytes = (
+            int((hbm.get("measured") or {}).get("peak_bytes") or 0)
+            if isinstance(hbm, dict) else None
+        )
         return info
 
     # ------------------------------------------------------------------

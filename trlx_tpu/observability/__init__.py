@@ -10,10 +10,25 @@ Everything here is dependency-free and OFF by default — components hold
 `inference.tracing` is set. See docs/observability.md.
 """
 
+from trlx_tpu.observability.compile_ledger import (
+    CompileLedger,
+    arg_signature,
+    ledgered_jit,
+    signature_diff,
+)
 from trlx_tpu.observability.flight_recorder import (
     FlightRecorder,
     all_recorders,
     snapshot_all,
+)
+from trlx_tpu.observability.hbm import (
+    HBM_BYTES,
+    HBMLedger,
+    device_hbm_bytes,
+    is_oom_error,
+    kv_arena_bytes,
+    largest_live_buffers,
+    oom_postmortem,
 )
 from trlx_tpu.observability.flops import (
     PEAK_FLOPS,
@@ -44,9 +59,12 @@ from trlx_tpu.observability.tracing import (
 )
 
 __all__ = [
+    "CompileLedger",
     "EPOCH_OFFSET",
     "FlightRecorder",
     "GoodputLedger",
+    "HBMLedger",
+    "HBM_BYTES",
     "PEAK_FLOPS",
     "PhaseTimeline",
     "RequestTrace",
@@ -56,14 +74,22 @@ __all__ = [
     "Tracer",
     "WASTE_CAUSES",
     "all_recorders",
+    "arg_signature",
     "chip_peak_flops",
     "default_slos",
+    "device_hbm_bytes",
     "dump_postmortem",
     "flops_per_cycle",
     "flops_per_sample",
+    "is_oom_error",
+    "kv_arena_bytes",
+    "largest_live_buffers",
+    "ledgered_jit",
     "maybe_dump",
     "new_id",
+    "oom_postmortem",
     "reset_triggers",
+    "signature_diff",
     "snapshot_all",
     "to_chrome_trace",
     "write_chrome_trace",

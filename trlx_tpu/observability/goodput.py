@@ -347,10 +347,16 @@ class GoodputLedger:
             lines.append(f"{ns}_{prom} {snap[key]}")
         return "\n".join(lines) + "\n"
 
-    def write(self, path: str) -> str:
+    def write(self, path: str,
+              extra: Optional[Dict[str, Any]] = None) -> str:
         """Atomic-ish goodput.json dump (tmp + rename so a crash mid-write
-        never leaves a truncated artifact — this runs every stats step)."""
+        never leaves a truncated artifact — this runs every stats step).
+        `extra` sections (compile ledger / hbm ledger snapshots) ride the
+        same file so one artifact answers "where did the time, compiles,
+        and bytes go"."""
         snap = self.snapshot()
+        if extra:
+            snap.update(extra)
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)

@@ -334,6 +334,10 @@ class PhaseTimeline:
         # the same hooks as the timing stats. Assigned, never constructed
         # here — the timeline stays dependency-free.
         self.ledger = None
+        # optional HBMLedger (observability/hbm.py): same hook — each
+        # phase boundary takes one device-memory sample into that
+        # phase's peak watermark.
+        self.hbm = None
 
     def phase(self, name: str, step: Optional[int] = None) -> "_PhaseCtx":
         return _PhaseCtx(self, name, step)
@@ -360,6 +364,10 @@ class PhaseTimeline:
         if ledger is not None:  # outside the lock: the ledger has its own
             ledger.observe_phase(name, t0, t1, first=first,
                                  attrs=span["attrs"])
+        hbm = self.hbm
+        if hbm is not None:
+            hbm.observe_phase(name, t0, t1, first=first,
+                              attrs=span["attrs"])
 
     def drain_stats(self) -> Dict[str, float]:
         """`timing/<phase>_ms` (steady-state mean since last drain) and

@@ -205,11 +205,37 @@ class TPUTrainer(BaseRLTrainer):
         # every wall second of learn() to a cause and computes live MFU
         # with bench.py's FLOP model. Only exists when tracing is on.
         self._goodput = None
+        # Compile ledger + HBM ledger (ISSUE 18): per-function recompile
+        # accounting with retrace-storm postmortems, and device-memory
+        # watermarks sampled at the same phase boundaries. Explicit
+        # context objects like the tracer — None when tracing is off, and
+        # every jit site then routes through plain jax.jit (bitwise
+        # identical programs, pinned by tests/test_compile_hbm.py).
+        self._compile_ledger = None
+        self._hbm = None
         if self._timeline is not None:
+            from trlx_tpu.observability.compile_ledger import CompileLedger
             from trlx_tpu.observability.goodput import GoodputLedger
+            from trlx_tpu.observability.hbm import HBMLedger
 
             self._goodput = GoodputLedger()
             self._timeline.ledger = self._goodput
+            self._compile_ledger = CompileLedger(
+                postmortem_dir=config.train.postmortem_dir,
+                config=config.to_dict() if hasattr(config, "to_dict") else None,
+            )
+            for fn_name, budget in (config.train.compile_budgets or {}).items():
+                self._compile_ledger.declare_budget(fn_name, budget)
+            self._hbm = HBMLedger()
+            self._timeline.hbm = self._hbm
+        # Opt-in persistent compilation cache: programs compiled by this
+        # (and any later) run of the same config are reloaded instead of
+        # recompiled; hits/misses show up in the compile ledger.
+        if config.train.compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir",
+                              config.train.compilation_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.0)
         self._last_stats: Dict[str, Any] = {}
         self._loop_pos: Optional[Dict[str, int]] = None
         self._resume_pos: Optional[Dict[str, int]] = None
@@ -306,6 +332,17 @@ class TPUTrainer(BaseRLTrainer):
         # which this design doesn't have.
         return key
 
+    def _ljit(self, fn, name: str, budget: int = 1, **jit_kwargs):
+        """The trainer's jit entry point: plain `jax.jit` when the
+        compile ledger is off (`train.tracing` unset — identical
+        programs), ledgered otherwise. Every jit site below routes
+        through here so each compiled function has a name and a declared
+        recompile budget (docs/observability.md lists them)."""
+        from trlx_tpu.observability.compile_ledger import ledgered_jit
+
+        return ledgered_jit(fn, name=name, budget=budget,
+                            ledger=self._compile_ledger, **jit_kwargs)
+
     def get_generate_fn(self, batch_size: int, prompt_len: int, gen_kwargs: Dict, mode: str = "lm",
                         capture: bool = False, spec_k: int = 0):
         """Jit-cached generate fn per (shape, kwargs) bucket. `capture`
@@ -331,7 +368,19 @@ class TPUTrainer(BaseRLTrainer):
                 spec_k=spec_k, spec_split=self.split if spec_k > 0 else 0,
                 spec_draft_head=self._spec_draft_head() if spec_k > 0 else None,
             )
-            self._generate_cache[key] = jax.jit(fn)
+            # each (shape, kwargs) bucket is its own compiled program by
+            # design — name it as such so each gets a budget of 1 and a
+            # retrace WITHIN a bucket (the actual invariant) still fires
+            import hashlib
+
+            kw_tag = hashlib.md5(key[2].encode()).hexdigest()[:6]
+            fn_name = (
+                f"generate[b{batch_size},p{prompt_len},{mode}"
+                + (",cap" if capture else "")
+                + (f",spec{spec_k}" if spec_k else "")
+                + f",kw{kw_tag}]"
+            )
+            self._generate_cache[key] = self._ljit(fn, fn_name)
         return self._generate_cache[key]
 
     def _spec_draft_head(self):
@@ -499,6 +548,13 @@ class TPUTrainer(BaseRLTrainer):
                 max_resident=icfg.max_resident_adapters,
                 hbm_budget_bytes=int(icfg.adapter_hbm_budget_mb * 1024 * 1024),
             )
+        serve_compile_ledger = serve_hbm = None
+        if icfg.tracing:
+            from trlx_tpu.observability.compile_ledger import CompileLedger
+            from trlx_tpu.observability.hbm import HBMLedger
+
+            serve_compile_ledger = CompileLedger()
+            serve_hbm = HBMLedger()
         engine = InferenceEngine(
             self.model, self.model_cfg, self.serving_params(), gen_cfg,
             num_slots=icfg.num_slots,
@@ -514,6 +570,8 @@ class TPUTrainer(BaseRLTrainer):
             prefix_cache_capacity=icfg.prefix_cache_capacity,
             multi_tenant=icfg.multi_tenant,
             adapter_store=adapter_store,
+            compile_ledger=serve_compile_ledger,
+            hbm_ledger=serve_hbm,
         )
         if icfg.sessions:
             engine.enable_sessions(
@@ -711,11 +769,13 @@ class TPUTrainer(BaseRLTrainer):
                 train_params, opt_state = pin(train_params, opt_state)
                 return train_params, opt_state, mean_stats
 
-        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 2))
-        self._train_scan_fn = jax.jit(train_scan, donate_argnums=(0, 2))
+        self._train_step_fn = self._ljit(
+            train_step, "train_step", donate_argnums=(0, 2))
+        self._train_scan_fn = self._ljit(
+            train_scan, "train_scan", donate_argnums=(0, 2))
         self._accum_fns = (
-            jax.jit(accum_step, donate_argnums=(2,)),
-            jax.jit(apply_step, donate_argnums=(0, 1, 2)),
+            self._ljit(accum_step, "accum_step", donate_argnums=(2,)),
+            self._ljit(apply_step, "apply_step", donate_argnums=(0, 1, 2)),
         )
 
     def batch_to_device(self, batch):
@@ -755,8 +815,44 @@ class TPUTrainer(BaseRLTrainer):
             return minibatch
         return [self.fault_injector.poison_batch(mb, fault) for mb in minibatch]
 
+    def _observability_extra(self) -> Dict[str, Any]:
+        """Compile/HBM ledger snapshots riding goodput.json ({} with the
+        ledgers off)."""
+        extra: Dict[str, Any] = {}
+        if self._compile_ledger is not None:
+            extra["compile"] = self._compile_ledger.snapshot()
+        if self._hbm is not None:
+            extra["hbm"] = self._hbm.snapshot()
+        return extra
+
+    def _maybe_oom_postmortem(self, site: str, exc: BaseException) -> None:
+        """OOM forensics at the train-step boundary: a RESOURCE_EXHAUSTED
+        escaping a train dispatch dumps a memory postmortem (ledger
+        snapshot, compile history, largest live buffers) once per site
+        before re-raising. Non-OOM errors pass through untouched; the
+        probe is one string match, so the happy path pays nothing."""
+        from trlx_tpu.observability.hbm import is_oom_error, oom_postmortem
+
+        if not is_oom_error(exc):
+            return
+        oom_postmortem(
+            site, exc, hbm=self._hbm, compile_ledger=self._compile_ledger,
+            context={"iter_count": self.iter_count,
+                     "last_stats_keys": sorted(self._last_stats)[:64]},
+            config=self.config.to_dict(),
+            out_dir=self.config.train.postmortem_dir,
+        )
+
     def train_minibatch(self, minibatch: List[Any]) -> Dict[str, float]:
-        """One optimizer step over `num_mb` microbatches."""
+        """One optimizer step over `num_mb` microbatches. OOM-guarded:
+        a RESOURCE_EXHAUSTED here leaves a memory postmortem bundle."""
+        try:
+            return self._train_minibatch_impl(minibatch)
+        except Exception as e:
+            self._maybe_oom_postmortem("train_step", e)
+            raise
+
+    def _train_minibatch_impl(self, minibatch: List[Any]) -> Dict[str, float]:
         if self._train_step_fn is None:
             self._build_steps()
         minibatch = self._maybe_inject_train_fault(minibatch)
@@ -810,7 +906,15 @@ class TPUTrainer(BaseRLTrainer):
 
     def train_batches_fused(self, batches) -> Tuple[Dict[str, float], int]:
         """Scan the train step over a homogeneous-shape batch prefix in one
-        dispatch; a ragged tail falls back to per-step dispatch."""
+        dispatch; a ragged tail falls back to per-step dispatch.
+        OOM-guarded like `train_minibatch`."""
+        try:
+            return self._train_batches_fused_impl(batches)
+        except Exception as e:
+            self._maybe_oom_postmortem("train_step_fused", e)
+            raise
+
+    def _train_batches_fused_impl(self, batches) -> Tuple[Dict[str, float], int]:
         if self._train_step_fn is None:
             self._build_steps()
         if not batches:
@@ -969,7 +1073,7 @@ class TPUTrainer(BaseRLTrainer):
                 try:
                     path = self._goodput.write(os.path.join(
                         self.config.train.trace_dir or "logs/traces",
-                        "goodput.json"))
+                        "goodput.json"), extra=self._observability_extra())
                     logger.info(f"Goodput ledger written to {path}")
                 except Exception:
                     logger.exception("failed to write the goodput ledger")
@@ -1205,9 +1309,18 @@ class TPUTrainer(BaseRLTrainer):
             # phase timeline land on disk EVERY stats step, not only at
             # learn() shutdown, so a killed run still leaves both
             stats.update(self._goodput.drain_stats())
+            if self._compile_ledger is not None:
+                # compile/* (per-fn recompile counts, storms, backend
+                # seconds, persistent-cache hits)
+                stats.update(self._compile_ledger.drain_stats())
+            if self._hbm is not None:
+                # hbm/* (measured peak bytes, analytic account)
+                stats.update(self._hbm.drain_stats())
             trace_dir = self.config.train.trace_dir or "logs/traces"
             try:
-                self._goodput.write(os.path.join(trace_dir, "goodput.json"))
+                self._goodput.write(
+                    os.path.join(trace_dir, "goodput.json"),
+                    extra=self._observability_extra())
                 # the timeline artifact grows with the span count, so its
                 # flush is throttled (the json above is O(1)-sized)
                 now = time.monotonic()

@@ -224,7 +224,7 @@ class GRPOTrainer(PPOTrainer):
             mean_kl = kl.sum(1).mean()
             return logprobs, ref_logprobs, log_ratio, mean_kl, mean_kl_per_token
 
-        self._score_fn = jax.jit(score)
+        self._score_fn = self._ljit(score, "grpo_score", budget=2)
 
     # ------------------------------------------------------------------
     # G-per-prompt experience collection

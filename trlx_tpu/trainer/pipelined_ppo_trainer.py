@@ -415,7 +415,7 @@ class PipelinedPPOTrainer(PipelinedCausalMixin, PPOTrainer):
             # KL, mean per-token KL) — the KL controller consumes the first
             return logprobs, values[:, :-1], log_ratio, kl.sum(1).mean(), kl.mean()
 
-        self._score_fn = jax.jit(score)
+        self._score_fn = self._ljit(score, "pipelined_score", budget=2)
 
     def create_train_dataloader(self, seed_offset: int = 0):
         # PPO's static-pad-width loader, with the pipelined drop_last

@@ -436,7 +436,7 @@ class PPOTrainer(TPUTrainer):
                 mean_kl = kl.sum(1).mean()
                 return logprobs, values[:, :-1], log_ratio, mean_kl, mean_kl_per_token
 
-            self._score_fn = jax.jit(score_seq2seq)
+            self._score_fn = self._ljit(score_seq2seq, "score_seq2seq", budget=2)
             return
 
         def score(train_params, frozen_params, ref_params, all_tokens):
@@ -455,7 +455,7 @@ class PPOTrainer(TPUTrainer):
             mean_kl = kl.sum(1).mean()
             return logprobs, values[:, :-1], log_ratio, mean_kl, mean_kl_per_token
 
-        self._score_fn = jax.jit(score)
+        self._score_fn = self._ljit(score, "score", budget=2)
 
     # ------------------------------------------------------------------
     # Disaggregated rollouts: the fleet backend (train.rollout_backend)
@@ -1532,7 +1532,11 @@ class PPOTrainer(TPUTrainer):
                 )
                 return chunk, mean_kl, mean_kl_per_token
 
-            return jax.jit(score_reward_s2s)
+            return self._ljit(
+                score_reward_s2s,
+                f"score_reward_s2s[{'scalar' if scalar_scores else 'dense'}]",
+                budget=2,
+            )
 
         def score_reward(train_params, frozen_params, ref_params,
                          prompt_tensors, sample_outputs, scores_eff, kl_coef):
@@ -1574,7 +1578,11 @@ class PPOTrainer(TPUTrainer):
             )
             return chunk, mean_kl, mean_kl_per_token
 
-        return jax.jit(score_reward)
+        return self._ljit(
+            score_reward,
+            f"score_reward[{'scalar' if scalar_scores else 'dense'}]",
+            budget=2,
+        )
 
     def train_epochs_from_chunk(self, chunk: PPORLBatch, n_epochs: int):
         """All inner epochs' optimizer steps from a DEVICE-resident chunk:
@@ -1784,14 +1792,15 @@ class PPOTrainer(TPUTrainer):
             )
             return h.astype(dtype)
 
-        return jax.jit(trunk, out_shardings=self._trunk_cache_sharding())
+        return self._ljit(trunk, "trunk_cache_fill", budget=2,
+                          out_shardings=self._trunk_cache_sharding())
 
     def _build_cache_cast_fn(self):
         """Jitted cast + placement for an ALREADY-captured h_split (the
         rollout fast path's in-loop capture) — no forward at all."""
         dtype = getattr(self.config.method, "trunk_cache_dtype", "bfloat16")
-        return jax.jit(
-            lambda h: h.astype(dtype),
+        return self._ljit(
+            lambda h: h.astype(dtype), "trunk_cache_cast", budget=2,
             out_shardings=self._trunk_cache_sharding(),
         )
 
@@ -1830,7 +1839,7 @@ class PPOTrainer(TPUTrainer):
         def trim(samples):
             return tok.device_retokenize(samples[:, q:], max_new)
 
-        return jax.jit(trim)
+        return self._ljit(trim, f"spec_trim[q{q},r{max_new}]")
 
     def _build_spec_fwd_fn(self, q: int, max_new: int):
         """Speculative half of _build_score_reward_fn: the policy/value/
@@ -1866,7 +1875,7 @@ class PPOTrainer(TPUTrainer):
                 kl.sum(1).mean(),
             )
 
-        return jax.jit(spec_fwd)
+        return self._ljit(spec_fwd, f"spec_fwd[q{q},r{max_new}]")
 
     def _build_spec_merge_fn(self, scalar_scores: bool):
         """Cheap tail of the scorer: per-token reward construction from the
@@ -1893,7 +1902,8 @@ class PPOTrainer(TPUTrainer):
                 rewards=rewards,
             )
 
-        return jax.jit(merge)
+        return self._ljit(
+            merge, f"spec_merge[{'scalar' if scalar_scores else 'dense'}]")
 
     def _dispatch_spec_score(self, out):
         """Dispatch the speculative trim (tiny) then the scorer forward
@@ -1958,7 +1968,7 @@ class PPOTrainer(TPUTrainer):
             # counts real response tokens only
             return lp_cap, v_cap, log_ratio_w, kl.sum(1).mean()
 
-        return jax.jit(fast_fwd)
+        return self._ljit(fast_fwd, f"fast_fwd[q{q},r{max_new}]")
 
     def _dispatch_fast_score(self, out):
         """Fast-path analogue of _dispatch_spec_score — same (trimmed,

@@ -288,4 +288,4 @@ class SequenceParallelPPOTrainer(PPOTrainer):
             mean_kl = kl.sum(1).mean()
             return logprobs, values_full[:, : t - 1], log_ratio, mean_kl, mean_kl_per_token
 
-        self._score_fn = jax.jit(score)
+        self._score_fn = self._ljit(score, "sp_score", budget=2)

@@ -40,7 +40,8 @@ def _merge_bench_record(path, record=None, **sections):
     except (OSError, ValueError):
         pass
     if record is not None:
-        keep = {k: merged[k] for k in ("paged_kv", "multi_tenant", "sessions")
+        keep = {k: merged[k]
+                for k in ("paged_kv", "multi_tenant", "sessions", "decode_kernel")
                 if k in merged}
         merged = {**record, **keep}
     merged.update(sections)

@@ -1,0 +1,258 @@
+"""Pallas paged-attention decode kernel (trlx_tpu/ops/paged_attention.py)
+and its engine wiring (inference.decode_kernel): the kernel in interpret
+mode must match the gather read path — bitwise on greedy token streams
+for f32 across slot reuse, block-boundary lengths and GQA ratios
+(n_kv_heads ∈ {1, 2, n_heads}); within the established dequant tolerance
+for int8 KV — while unsupported shapes fall back per dispatch with a
+counted reason surfaced through kv_stats."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from trlx_tpu.inference import InferenceEngine
+from trlx_tpu.ops import quant
+from trlx_tpu.ops.attention import kernel_mode
+from trlx_tpu.ops.paged_attention import (
+    paged_attention_decode,
+    paged_attention_reference,
+)
+from trlx_tpu.ops.sampling import GenerationConfig
+
+EOS_FREE = 10_000  # an id the byte model never emits -> length-capped runs
+
+
+def _build_trainer(preset, dtype="float32"):
+    from trlx_tpu.data.default_configs import default_sft_config
+    from trlx_tpu.trainer.sft_trainer import SFTTrainer
+
+    config = default_sft_config().evolve(
+        model=dict(
+            model_path=f"random:{preset}",
+            model_extra_configs={"dtype": dtype},
+        ),
+        tokenizer=dict(tokenizer_path="byte"),
+        train=dict(seq_length=64, total_steps=0, tracker=None, batch_size=2),
+    )
+    return SFTTrainer(config)
+
+
+@pytest.fixture(scope="module")
+def trainers():
+    """One tiny model per GQA ratio: gpt2-tiny (nkv == nh), llama-tiny
+    (nkv == 2), bigcode-tiny (MQA, nkv == 1)."""
+    return {p: _build_trainer(p) for p in ("gpt2-tiny", "llama-tiny", "bigcode-tiny")}
+
+
+def make_engine(trainer, decode_kernel, max_new=8, **kw):
+    gen_cfg = GenerationConfig(
+        max_new_tokens=max_new, do_sample=False,
+        eos_token_id=EOS_FREE, pad_token_id=trainer.tokenizer.pad_token_id,
+    )
+    return InferenceEngine(
+        trainer.model, trainer.model_cfg, trainer.params, gen_cfg,
+        num_slots=2, max_prompt_len=32, kv_paging=True, kv_block_size=8,
+        decode_kernel=decode_kernel, **kw,
+    )
+
+
+def run_serial(engine, prompts, max_new=8, slot=0):
+    """Decode each prompt to completion in the SAME slot — slot reuse with
+    block reclaim between requests."""
+    outs = []
+    for p in prompts:
+        engine.insert_requests([(np.asarray(p, np.int32), max_new)], [slot])
+        toks = []
+        for _ in range(max_new):
+            t, lp, v, f = engine.step()
+            if v[slot]:
+                toks.append(int(t[slot]))
+            if f[slot]:
+                break
+        engine.reclaim_slots([slot])
+        outs.append(toks)
+    return outs
+
+
+# prompt lengths straddling the kv_block_size=8 boundaries: 7 (inside
+# block 0), 8 (exactly one block), 9 (first token of block 1), 15/16/17
+# (the block-2 boundary), plus slot-reuse across all of them
+BOUNDARY_PROMPTS = [
+    list(range(60, 60 + n)) for n in (7, 8, 9, 15, 16, 17)
+]
+
+
+# ----------------------------------------------------------------------
+# Kernel units: interpret-mode kernel vs the XLA gather-path reference
+# ----------------------------------------------------------------------
+
+def _random_paged_case(rng, nh, nkv, b=3, hd=16, blk=8, n_tbl=4, n_blocks=10):
+    q = jnp.asarray(rng.randn(b, nh, hd), jnp.float32)
+    ka = jnp.asarray(rng.randn(n_blocks, blk, nkv, hd), jnp.float32).at[0].set(0.0)
+    va = jnp.asarray(rng.randn(n_blocks, blk, nkv, hd), jnp.float32).at[0].set(0.0)
+    table = jnp.asarray(rng.randint(0, n_blocks, (b, n_tbl)), jnp.int32)
+    # lengths at / around block boundaries, plus one inactive row
+    lens = jnp.asarray([blk - 1, 2 * blk + 1, 0], jnp.int32)[:b]
+    cols = jnp.arange(n_tbl * blk)[None, :]
+    mask = (cols < lens[:, None]).astype(jnp.int32)
+    return q, ka, va, table, mask, lens
+
+
+@pytest.mark.parametrize("nh,nkv", [(4, 4), (4, 2), (4, 1)])
+def test_kernel_matches_reference_gqa(nh, nkv):
+    rng = np.random.RandomState(0)
+    q, ka, va, table, mask, lens = _random_paged_case(rng, nh, nkv)
+    out_k = paged_attention_decode(q, ka, va, table, mask, interpret=True)
+    out_r = paged_attention_reference(q, ka, va, table, mask)
+    active = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(out_k)[active], np.asarray(out_r)[active],
+        rtol=1e-5, atol=1e-5,
+    )
+    # fully-masked rows: the kernel returns exact zero (the dense path's
+    # uniform-softmax garbage is never emitted either way)
+    assert bool(jnp.all(out_k[~active] == 0.0))
+
+
+@pytest.mark.parametrize("nh,nkv", [(4, 4), (4, 2), (4, 1)])
+def test_kernel_int8_in_kernel_dequant(nh, nkv):
+    rng = np.random.RandomState(1)
+    q, ka, va, table, mask, lens = _random_paged_case(rng, nh, nkv)
+    kq, ks = quant.quantize_kv(ka)
+    vq, vs = quant.quantize_kv(va)
+    out_k = paged_attention_decode(
+        q, kq, vq, table, mask, k_scale=ks, v_scale=vs, interpret=True
+    )
+    out_r = paged_attention_reference(
+        q, kq, vq, table, mask, k_scale=ks, v_scale=vs
+    )
+    active = np.asarray(lens) > 0
+    np.testing.assert_allclose(
+        np.asarray(out_k)[active], np.asarray(out_r)[active],
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_kernel_requires_scales_for_int8():
+    rng = np.random.RandomState(2)
+    q, ka, va, table, mask, _ = _random_paged_case(rng, 4, 2)
+    kq, ks = quant.quantize_kv(ka)
+    vq, vs = quant.quantize_kv(va)
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention_decode(q, kq, vq, table, mask, interpret=True)
+
+
+# ----------------------------------------------------------------------
+# Engine-level greedy bit-identity: kernel (interpret) vs gather path
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("preset", ["gpt2-tiny", "llama-tiny", "bigcode-tiny"])
+def test_greedy_bitwise_f32_slot_reuse_and_boundaries(trainers, preset):
+    tr = trainers[preset]
+    gather = run_serial(make_engine(tr, "xla"), BOUNDARY_PROMPTS)
+    kernel = run_serial(make_engine(tr, "pallas"), BOUNDARY_PROMPTS)
+    assert kernel == gather
+
+
+def test_greedy_bitwise_bf16_kv(trainers):
+    """bf16 KV arena: the kernel accumulates in f32 (like the gather
+    path's f32 score einsum), so greedy streams stay bitwise."""
+    tr = trainers["llama-tiny"]
+    gather = run_serial(
+        make_engine(tr, "xla", kv_cache_dtype="bf16"), BOUNDARY_PROMPTS
+    )
+    kernel = run_serial(
+        make_engine(tr, "pallas", kv_cache_dtype="bf16"), BOUNDARY_PROMPTS
+    )
+    assert kernel == gather
+
+
+def test_greedy_int8_within_dequant_tolerance(trainers):
+    """int8 KV quantizes identically on both read paths; the tiny random
+    model's greedy streams may rarely diverge at near-tie logits, the
+    same tolerance test_paged_kv grants the gather path."""
+    tr = trainers["gpt2-tiny"]
+    gather = run_serial(
+        make_engine(tr, "xla", kv_cache_dtype="int8"), BOUNDARY_PROMPTS
+    )
+    kernel = run_serial(
+        make_engine(tr, "pallas", kv_cache_dtype="int8"), BOUNDARY_PROMPTS
+    )
+    matches = sum(a == b for a, b in zip(gather, kernel))
+    assert matches >= len(BOUNDARY_PROMPTS) - 1, (gather, kernel)
+
+
+def test_decode_kernel_xla_pins_todays_path(trainers):
+    """decode_kernel='xla' must be byte-for-byte today's engine: same
+    greedy stream as the default engine with kernels disabled."""
+    tr = trainers["gpt2-tiny"]
+    eng = make_engine(tr, "xla")
+    assert eng._attn_kernel is None
+    assert "kv_kernel_dispatches" in eng.kv_stats()
+    out = run_serial(eng, BOUNDARY_PROMPTS[:2])
+    assert eng.kv_stats()["kv_kernel_dispatches"] == 0
+    assert eng.kv_stats()["kv_kernel_fallbacks"] == {}
+    # default ctor value is "auto" -> gather path on CPU: identical
+    default = make_engine(tr, "auto")
+    assert default._attn_kernel is None
+    assert run_serial(default, BOUNDARY_PROMPTS[:2]) == out
+
+
+# ----------------------------------------------------------------------
+# Dispatch counters and fallback reasons
+# ----------------------------------------------------------------------
+
+def test_kernel_dispatch_counters(trainers):
+    tr = trainers["llama-tiny"]
+    eng = make_engine(tr, "pallas")
+    assert eng._attn_kernel == "interpret"  # explicit request off-TPU
+    run_serial(eng, BOUNDARY_PROMPTS[:2], max_new=4)
+    stats = eng.kv_stats()
+    assert stats["kv_kernel_dispatches"] > 0
+    assert stats["kv_kernel_fallbacks"] == {}
+
+
+def test_alibi_falls_back_with_reason():
+    tr = _build_trainer("bloom-tiny")  # alibi=True
+    eng = make_engine(tr, "pallas")
+    assert eng._kernel_unsupported == "alibi"
+    kernel = run_serial(eng, BOUNDARY_PROMPTS[:1], max_new=4)
+    stats = eng.kv_stats()
+    assert stats["kv_kernel_dispatches"] == 0
+    assert stats["kv_kernel_fallbacks"].get("alibi", 0) > 0
+    # the fallback serves the gather path's exact tokens
+    gather = run_serial(make_engine(tr, "xla"), BOUNDARY_PROMPTS[:1], max_new=4)
+    assert kernel == gather
+
+
+def test_invalid_decode_kernel_rejected(trainers):
+    with pytest.raises(ValueError, match="decode_kernel"):
+        make_engine(trainers["gpt2-tiny"], "mosaic")
+
+
+# ----------------------------------------------------------------------
+# Shared kernel-mode helper (env override + CPU safety)
+# ----------------------------------------------------------------------
+
+def test_kernel_mode_env_override(monkeypatch):
+    # tier-1 runs under JAX_PLATFORMS=cpu: never the compiled kernel
+    monkeypatch.delenv("TRLX_TPU_KERNELS", raising=False)
+    assert kernel_mode() in ("off", "pallas")  # pallas only on real TPU
+    monkeypatch.setenv("TRLX_TPU_KERNELS", "off")
+    assert kernel_mode() == "off"
+    monkeypatch.setenv("TRLX_TPU_KERNELS", "interpret")
+    assert kernel_mode() == "interpret"
+    # a forced kernel off-TPU degrades to interpret, never compiled
+    monkeypatch.setenv("TRLX_TPU_KERNELS", "pallas")
+    import jax
+
+    expected = "pallas" if (
+        jax.default_backend() == "tpu" and jax.device_count() == 1
+    ) else "interpret"
+    assert kernel_mode() == expected
+
+
+def test_env_kill_switch_pins_gather_path(trainers, monkeypatch):
+    monkeypatch.setenv("TRLX_TPU_KERNELS", "off")
+    eng = make_engine(trainers["gpt2-tiny"], "pallas")
+    assert eng._attn_kernel is None

@@ -209,6 +209,18 @@ class InferenceConfig:
     :param kv_cache_dtype: "auto" (model dtype) | "f32" | "bf16" |
         "int8" (per-token-per-head symmetric quantization, paged only —
         halves/quarters KV bytes at a small logit tolerance).
+    :param decode_kernel: paged decode attention read path. "auto"
+        (default) uses the fused Pallas paged-attention kernel
+        (`ops/paged_attention.py`: direct block-table KV fetch, in-kernel
+        int8 dequant, online flash softmax, GQA-grouped) on a single TPU
+        chip and the gather path elsewhere; "xla" pins today's
+        gather+dense-softmax read path bitwise; "pallas" requests the
+        kernel explicitly, running it through the Pallas interpreter
+        off-TPU (CPU-executable, same blockwise math — the CI smoke).
+        Shapes the kernel cannot express (spec-decode verify rows,
+        alibi/sliding-window biases, paging off) fall back to the gather
+        path per dispatch with a counted reason
+        (``kv_kernel_fallbacks{reason}`` in /metrics and healthz).
     :param prefix_cache: share prompt-prefix KV blocks across requests
         (exact token-chain keys, refcounted, LRU-evicted when idle);
         requires kv_paging.
@@ -286,6 +298,7 @@ class InferenceConfig:
     kv_block_size: int = 32
     kv_pool_blocks: int = 0
     kv_cache_dtype: str = "auto"
+    decode_kernel: str = "auto"
     prefix_cache: bool = False
     prefix_cache_capacity: int = 0
     multi_tenant: bool = False

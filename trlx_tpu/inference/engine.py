@@ -33,6 +33,7 @@ from any thread (checkpoint hot-reload) and swaps atomically under a
 lock read at each dispatch.
 """
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -121,6 +122,7 @@ class InferenceEngine:
         prefix_cache_capacity: int = 0,
         multi_tenant: bool = False,
         adapter_store=None,
+        decode_kernel: str = "auto",
         compile_ledger=None,
         hbm_ledger=None,
     ):
@@ -305,6 +307,24 @@ class InferenceEngine:
             # stay in-bounds (the store never shrinks its stack), so they
             # only feed rows whose outputs are already ignored.
             self._pool["adapter"] = jnp.zeros((P,), jnp.int32)
+        # Paged decode kernel (ops/paged_attention.py) behind the
+        # inference.decode_kernel knob: "xla" pins today's gather read
+        # path bitwise; "auto" selects the Pallas kernel on a single TPU
+        # chip and the gather path elsewhere; "pallas" requests the
+        # kernel explicitly, degrading to interpret mode off-TPU (the CI
+        # smoke) — the TRLX_TPU_KERNELS env kill switch overrides all of
+        # it (ops.attention.kernel_mode, shared with the flash path).
+        # Per-dispatch fallbacks to the gather path are counted with a
+        # reason (kv_stats -> scheduler -> /metrics + healthz).
+        if decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"decode_kernel {decode_kernel!r} not in ('auto', 'pallas', 'xla')"
+            )
+        self.decode_kernel = decode_kernel
+        self._attn_kernel = self._resolve_attn_kernel()
+        self._kernel_unsupported = self._kernel_unsupported_reason()
+        self._kv_kernel_dispatches = 0
+        self._kv_kernel_fallbacks: Dict[str, int] = {}
         self._prefill_fns: Dict[Tuple[int, int], Callable] = {}
         self._insert_fns: Dict[int, Callable] = {}
         self._paged_insert_fns: Dict[Tuple[int, int], Callable] = {}
@@ -316,6 +336,40 @@ class InferenceEngine:
                 n_blocks=self._n_blocks, block_size=self.kv_block_size,
                 dtype=str(jnp.dtype(self.kv_cache_dtype)),
             )
+
+    def _resolve_attn_kernel(self) -> Optional[str]:
+        """Map the decode_kernel knob onto the per-dispatch attn_kernel
+        value threaded into decode_step_rows: None (gather path),
+        "pallas" (compiled Mosaic kernel) or "interpret" (same kernel
+        through the Pallas interpreter — CPU-executable)."""
+        from trlx_tpu.ops.attention import kernel_mode
+
+        env = os.environ.get("TRLX_TPU_KERNELS", "").strip().lower()
+        if self.decode_kernel == "xla" or env in ("off", "xla", "0"):
+            return None
+        mode = kernel_mode()
+        if mode == "pallas":
+            return "pallas"
+        if self.decode_kernel == "pallas" or mode == "interpret":
+            # explicit request off-TPU (or env-forced interpret): run the
+            # kernel through the interpreter rather than silently using
+            # the gather path — same blockwise math, CPU-executable
+            return "interpret"
+        return None  # auto off-TPU: gather path
+
+    def _kernel_unsupported_reason(self) -> Optional[str]:
+        """Engine-static reason the paged decode kernel cannot serve this
+        config (counted once per decode dispatch), or None. Per-dispatch
+        dynamic shapes (spec-decode verify rows) are counted at the
+        dispatch site instead."""
+        cfg = self.model_cfg
+        if not self.kv_paging:
+            return "kv_paging_off"
+        if getattr(cfg, "alibi", False):
+            return "alibi"
+        if getattr(cfg, "sliding_window", None) is not None:
+            return "sliding_window"
+        return None
 
     def _ljit(self, fn, name: str, budget: int = 1, **jit_kwargs):
         """Engine jit entry point — plain jax.jit when no compile ledger
@@ -885,6 +939,10 @@ class InferenceEngine:
         sample_fused = self._sample_fused
         paged = self.kv_paging
         mt = self.multi_tenant
+        # closure constant: the fused paged read path, or None for the
+        # pinned gather path (unsupported configs fall back here and are
+        # counted per dispatch in _step_impl)
+        ak = self._attn_kernel if self._kernel_unsupported is None else None
 
         def decode(params, pool, stack=None):
             params = dequantize_tree(params)
@@ -915,6 +973,7 @@ class InferenceEngine:
                 variables, token[:, None], cache,
                 valid.astype(jnp.int32)[:, None],
                 method=type(model).decode_step_rows,
+                attn_kernel=ak,
             )
             if paged:
                 new_cache = dict(new_cache, layers=[
@@ -939,7 +998,12 @@ class InferenceEngine:
             }
             return new_pool, token, logprob, valid, finished
 
-        return self._ljit(decode, "engine.decode", donate_argnums=(1,))
+        # distinct ledger site per read path (budget 1 either way): a
+        # kernel-enabled engine retracing into the gather program — or
+        # vice versa — must show up as a budget violation, not hide
+        # under the other site's compile
+        site = "engine.decode" if ak is None else f"engine.decode[{ak}]"
+        return self._ljit(decode, site, donate_argnums=(1,))
 
     def _make_spec_decode(self) -> Callable:
         """Speculative slot decode: one call emits the slot's pending
@@ -959,6 +1023,10 @@ class InferenceEngine:
         greedy = (not gen_cfg.do_sample) or (gen_cfg.temperature == 0.0)
         suppress = self._suppress
         paged = self.kv_paging
+        # trunk draft steps are decode-shaped (t == 1) and ride the fused
+        # kernel; the batched multi-position verify cannot (counted as a
+        # per-dispatch "spec_verify_rows" fallback in _step_impl)
+        ak = self._attn_kernel if self._kernel_unsupported is None else None
 
         def warp(raw_logits, step):
             scores = raw_logits
@@ -987,6 +1055,7 @@ class InferenceEngine:
                 h_j, hn_j, cache = model.apply(
                     {"params": params}, f[:, None], cache, act_i[:, None],
                     split, method=type(model).spec_draft_step,
+                    attn_kernel=ak,
                 )
                 h_rows.append(h_j)
                 if j < k:
@@ -1119,7 +1188,8 @@ class InferenceEngine:
             }
             return new_pool, emit_mat, lp_mat, valid_mat, finished
 
-        return self._ljit(decode, "engine.spec_decode", donate_argnums=(1,))
+        site = "engine.spec_decode" if ak is None else f"engine.spec_decode[{ak}]"
+        return self._ljit(decode, site, donate_argnums=(1,))
 
     def _maybe_oom_postmortem(self, site: str, exc: BaseException) -> None:
         """OOM forensics at the engine-dispatch boundary: RESOURCE_EXHAUSTED
@@ -1178,6 +1248,23 @@ class InferenceEngine:
         the pool. The logprob is the policy's raw-logit log-probability
         of the emitted token (see `_sample_fused`), meaningful only where
         `emitted`."""
+        # kernel dispatch accounting (driver thread; read under _kv_lock
+        # by kv_stats): a decode dispatch either rides the fused kernel
+        # or falls back to the gather path for a counted reason. The
+        # spec path counts BOTH — its t=1 trunk draft steps use the
+        # kernel while the multi-position verify cannot, so every spec
+        # dispatch also logs a "spec_verify_rows" fallback explaining
+        # the non-kernel portion.
+        if self._attn_kernel is not None:
+            if self._kernel_unsupported is not None:
+                r = self._kernel_unsupported
+                self._kv_kernel_fallbacks[r] = self._kv_kernel_fallbacks.get(r, 0) + 1
+            else:
+                self._kv_kernel_dispatches += 1
+                if self.spec_k > 0:
+                    self._kv_kernel_fallbacks["spec_verify_rows"] = (
+                        self._kv_kernel_fallbacks.get("spec_verify_rows", 0) + 1
+                    )
         if self.spec_k > 0:
             params, head = self._current_params_and_head()
             self._pool, token, logprob, valid, finished = self._decode_fn(
@@ -1278,9 +1365,10 @@ class InferenceEngine:
         """Allocatable blocks (zero block excluded); 0 when paging is off."""
         return self._block_pool.total if self.kv_paging else 0
 
-    def kv_stats(self) -> Dict[str, int]:
+    def kv_stats(self) -> Dict[str, Any]:
         """Host-side paged-pool counters for metrics/healthz; {} when
-        paging is off."""
+        paging is off. `kv_kernel_fallbacks` is a {reason: count} dict;
+        everything else is an int."""
         if not self.kv_paging:
             return {}
         # single source of truth for arena bytes (incl. int8 scale
@@ -1305,6 +1393,8 @@ class InferenceEngine:
                 "prefix_cache_misses": pool.misses,
                 "prefix_cache_evictions": pool.evictions,
                 "prefix_cache_idle_blocks": pool.cached_idle(),
+                "kv_kernel_dispatches": self._kv_kernel_dispatches,
+                "kv_kernel_fallbacks": dict(self._kv_kernel_fallbacks),
             }
 
     # ------------------------------------------------------------------

@@ -966,6 +966,16 @@ class Scheduler:
             "prefix_cache_hits", "prefix_cache_misses", "prefix_cache_evictions",
         ):
             self.metrics.set_counter(name, stats[name])
+        # paged decode kernel dispatch accounting (absolute-synced like
+        # the prefix-cache counters; fallbacks keyed by reason label)
+        if "kv_kernel_dispatches" in stats:
+            self.metrics.set_counter(
+                "kv_kernel_dispatches", stats["kv_kernel_dispatches"]
+            )
+            for reason, n in sorted(stats.get("kv_kernel_fallbacks", {}).items()):
+                self.metrics.set_counter(
+                    "kv_kernel_fallbacks", n, labels={"reason": reason}
+                )
         sstore = getattr(self.engine, "session_store", None)
         if sstore is not None:
             sstats = sstore.stats()

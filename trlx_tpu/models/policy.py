@@ -206,20 +206,22 @@ class CausalLMWithValueHead(nn.Module):
             return logits, values, new_cache, h_cap
         return logits, values, new_cache
 
-    def decode_step_rows(self, tokens, cache, token_mask):
+    def decode_step_rows(self, tokens, cache, token_mask, attn_kernel=None):
         """Per-row-offset cached decode (continuous-batching slot pool,
         trlx_tpu/inference/engine.py). Returns (logits, new_cache)."""
-        return self.lm.decode_step_rows(tokens, cache, token_mask)
+        return self.lm.decode_step_rows(tokens, cache, token_mask, attn_kernel)
 
     def prefill_rows(self, tokens, cache, token_mask):
         """Per-row-offset multi-token prefill (the paged engine's insert
         path). Returns (logits, new_cache)."""
         return self.lm.prefill_rows(tokens, cache, token_mask)
 
-    def spec_draft_step(self, tokens, cache, token_mask, split: int):
+    def spec_draft_step(self, tokens, cache, token_mask, split: int,
+                        attn_kernel=None):
         """Trunk-only per-row draft step (self-speculative decode). Returns
         (h_split, h_norm, new_cache) — no heads run during drafting."""
-        return self.lm.spec_draft_step(tokens, cache, token_mask, split)
+        return self.lm.spec_draft_step(tokens, cache, token_mask, split,
+                                       attn_kernel)
 
     def spec_verify_rows(self, h, cache, row_start, positions, split: int,
                          with_value: bool = False, token_mask=None):
@@ -325,11 +327,11 @@ class CausalLMWithILQLHeads(nn.Module):
         qs, target_qs, vs = self.ilql_heads(h)
         return logits, qs, target_qs, vs, new_cache
 
-    def decode_step_rows(self, tokens, cache, token_mask):
+    def decode_step_rows(self, tokens, cache, token_mask, attn_kernel=None):
         """Per-row-offset cached decode (continuous-batching slot pool).
         Plain-LM logits only — the ILQL advantage shift is a training-time
         sampler feature; serve ILQL policies with the static engine."""
-        return self.lm.decode_step_rows(tokens, cache, token_mask)
+        return self.lm.decode_step_rows(tokens, cache, token_mask, attn_kernel)
 
     def prefill_rows(self, tokens, cache, token_mask):
         """Per-row-offset multi-token prefill (paged engine insert)."""

@@ -277,6 +277,7 @@ class Attention(nn.Module):
         cache_index: Optional[jnp.ndarray] = None,
         attn_mask: Optional[jnp.ndarray] = None,  # [b, t] key validity (fused paths)
         use_prefix: bool = True,
+        attn_kernel: Optional[str] = None,  # paged decode: None | "pallas" | "interpret"
     ):
         cfg = self.cfg
         b, t, d = h.shape
@@ -332,6 +333,49 @@ class Attention(nn.Module):
                     "v_scale": layer_cache["v_scale"].at[phys, off].set(vs),
                     "table": table,
                 }
+            else:
+                new_cache = {
+                    "k": arena_k.at[phys, off].set(k.astype(arena_k.dtype)),
+                    "v": arena_v.at[phys, off].set(v.astype(arena_v.dtype)),
+                    "table": table,
+                }
+            if attn_kernel is not None:
+                # Fused Pallas read side (ops/paged_attention.py): one pass
+                # per (slot, kv-head) walks the block table directly — no
+                # gathered dense copy, no materialized dequant, no kv-head
+                # repeat. The engine guarantees the shape is expressible
+                # (t == 1, no alibi/window/prefix bias terms) and counts a
+                # fallback to the gather path otherwise.
+                if t != 1:
+                    raise ValueError(
+                        "paged decode kernel takes single-position queries; "
+                        f"got t={t} (engine should have fallen back)"
+                    )
+                if cfg.alibi or cfg.sliding_window is not None or cfg.prefix_tokens > 0:
+                    raise ValueError(
+                        "paged decode kernel cannot express alibi/window/"
+                        "prefix bias terms (engine should have fallen back)"
+                    )
+                from trlx_tpu.ops.paged_attention import paged_attention_decode
+
+                # decode_bias writes exactly 0.0 on attendable columns and
+                # -1e9 elsewhere, so key validity is recoverable from the
+                # bias row without widening the call signature.
+                key_mask = attn_bias[:, 0, 0, :] == 0.0
+                kernel_out = paged_attention_decode(
+                    q[:, 0],
+                    new_cache["k"],
+                    new_cache["v"],
+                    table,
+                    key_mask,
+                    k_scale=new_cache.get("k_scale"),
+                    v_scale=new_cache.get("v_scale"),
+                    out_dtype=cfg.dtype,
+                    interpret=(attn_kernel == "interpret"),
+                )
+                out = dense(d, "o_proj")(kernel_out.reshape(b, 1, nh * hd))
+                return out, new_cache
+            if arena_k.dtype == jnp.int8:
                 k = quant.dequantize_kv(
                     new_cache["k"][table].reshape(b, n_tbl * blk_sz, nkv, hd),
                     new_cache["k_scale"][table].reshape(b, n_tbl * blk_sz, nkv),
@@ -343,11 +387,6 @@ class Attention(nn.Module):
                     cfg.dtype,
                 )
             else:
-                new_cache = {
-                    "k": arena_k.at[phys, off].set(k.astype(arena_k.dtype)),
-                    "v": arena_v.at[phys, off].set(v.astype(arena_v.dtype)),
-                    "table": table,
-                }
                 k = new_cache["k"][table].reshape(b, n_tbl * blk_sz, nkv, hd)
                 v = new_cache["v"][table].reshape(b, n_tbl * blk_sz, nkv, hd)
         elif layer_cache is not None:
@@ -521,11 +560,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, h, attn_bias, positions, layer_cache=None, cache_index=None, attn_mask=None,
-                 use_prefix=True):
+                 use_prefix=True, attn_kernel=None):
         cfg = self.cfg
         h_ln = make_norm(cfg, "ln_attn")(h)
         attn_out, new_cache = Attention(cfg, name="attn")(
-            h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix
+            h_ln, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix,
+            attn_kernel,
         )
         mlp_cls = MoEMLP if cfg.moe_experts > 0 else MLP
         if cfg.parallel_residual:
@@ -612,8 +652,9 @@ class TransformerLM(nn.Module):
                 "soft_prompt", nn.initializers.normal(stddev=0.02),
                 (cfg.prompt_tokens, cfg.d_model), cfg.param_dtype,
             )
-        # use_prefix (arg 7 counting the module) is a static python bool
-        block_cls = nn.remat(Block, static_argnums=(7,)) if cfg.remat_blocks else Block
+        # use_prefix (arg 7 counting the module) and attn_kernel (arg 8)
+        # are static python values
+        block_cls = nn.remat(Block, static_argnums=(7, 8)) if cfg.remat_blocks else Block
         self.blocks = [block_cls(cfg, name=f"block_{i}") for i in range(cfg.n_layers)]
         self.ln_f = make_norm(cfg, "ln_f")
         if not cfg.tie_embeddings:
@@ -665,11 +706,11 @@ class TransformerLM(nn.Module):
     def _train_bias(self, attn_mask):
         return train_bias(self.cfg, attn_mask)
 
-    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None, use_prefix: bool = True):
+    def run_blocks(self, h, attn_bias, positions, start: int, stop: int, cache=None, cache_index=None, attn_mask=None, use_prefix: bool = True, attn_kernel: Optional[str] = None):
         new_layers = [] if cache is not None else None
         for i in range(start, stop):
             layer_cache = cache[i] if cache is not None else None
-            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix)
+            h, new_cache = self.blocks[i](h, attn_bias, positions, layer_cache, cache_index, attn_mask, use_prefix, attn_kernel)
             if cache is not None:
                 new_layers.append(new_cache)
         return h, new_layers
@@ -976,6 +1017,7 @@ class TransformerLM(nn.Module):
         tokens: jnp.ndarray,  # [b, 1]
         cache: Dict[str, Any],
         token_mask: jnp.ndarray,  # [b, 1] validity (0 = free/inactive slot)
+        attn_kernel: Optional[str] = None,  # paged read path: None | "pallas" | "interpret"
     ):
         """One cached decode step where every row carries its OWN write
         offset (`cache["row_index"]`, [b]) — the continuous-batching slot
@@ -1010,6 +1052,7 @@ class TransformerLM(nn.Module):
         h, new_layers = self.run_blocks(
             h, bias, positions, 0, self.cfg.n_layers,
             cache=cache["layers"], cache_index=row_index, attn_mask=token_mask,
+            attn_kernel=attn_kernel,
         )
         logits, _ = self.unembed(h)
         new_cache = {
@@ -1086,6 +1129,7 @@ class TransformerLM(nn.Module):
         cache: Dict[str, Any],
         token_mask: jnp.ndarray,  # [b, 1] validity (0 = finished/inactive row)
         split: int,
+        attn_kernel: Optional[str] = None,
     ):
         """One per-row cached TRUNK step (blocks [0, split) only) for
         self-speculative drafting: embed + frozen-prefix blocks, no
@@ -1118,7 +1162,7 @@ class TransformerLM(nn.Module):
         h = self.embed(tokens, positions)
         h, trunk_layers = self.run_blocks(
             h, bias, positions, 0, split, cache=cache["layers"],
-            cache_index=row_index, attn_mask=token_mask,
+            cache_index=row_index, attn_mask=token_mask, attn_kernel=attn_kernel,
         )
         new_cache = {
             "row_index": row_index + step_valid,

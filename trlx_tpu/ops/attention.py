@@ -28,6 +28,7 @@ the kernel instead of an O(t^2) bias tensor.
 """
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -307,17 +308,43 @@ def _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k, interpret=False):
     return out.reshape(b, nh, tq, hd).transpose(0, 2, 1, 3)
 
 
-def _use_pallas() -> bool:
-    # The Pallas call carries no GSPMD partitioning rule, so under a
-    # multi-device jit XLA would replicate its operands instead of splitting
-    # the batch. Single chip → Pallas kernel; multi-chip GSPMD → the
-    # shard_map-wrapped kernel when a standard mesh is registered
-    # (active_pallas_mesh below), else blockwise XLA (fully partitionable;
-    # same math). Ring attention owns the sequence-sharded case.
+def kernel_mode() -> str:
+    """Single source of truth for Pallas kernel selection, shared by the
+    flash (prefill/train) dispatch below and the paged-attention decode
+    kernel (`ops/paged_attention.py` via `inference.decode_kernel`):
+
+    * ``"pallas"``    — compile the Mosaic TPU kernel. Only ever returned
+      when the backend really is a single TPU chip (the pallas_call
+      carries no GSPMD partitioning rule, so under a multi-device jit XLA
+      would replicate its operands instead of splitting the batch;
+      multi-chip goes through the shard_map wrappers or blockwise XLA,
+      and ring attention owns the sequence-sharded case).
+    * ``"interpret"`` — run the SAME kernel through the Pallas
+      interpreter (CPU-executable, same blockwise math). Never selected
+      by default: it exists for parity tests and the CI smoke.
+    * ``"off"``       — use the plain XLA paths.
+
+    The ``TRLX_TPU_KERNELS`` env var overrides: ``off``/``xla``/``0``
+    force the XLA paths, ``interpret`` forces the interpreter, and
+    ``pallas``/``1``/``force`` requests the compiled kernel — degraded to
+    ``interpret`` off-TPU, so a ``JAX_PLATFORMS=cpu`` run (tier-1 CI) can
+    never select a compiled TPU kernel no matter what the env says."""
+    env = os.environ.get("TRLX_TPU_KERNELS", "").strip().lower()
+    if env in ("off", "xla", "0"):
+        return "off"
+    if env == "interpret":
+        return "interpret"
     try:
-        return jax.default_backend() == "tpu" and jax.device_count() == 1
+        on_single_tpu = jax.default_backend() == "tpu" and jax.device_count() == 1
     except Exception:
-        return False
+        on_single_tpu = False
+    if env in ("pallas", "1", "force"):
+        return "pallas" if on_single_tpu else "interpret"
+    return "pallas" if on_single_tpu else "off"
+
+
+def _use_pallas() -> bool:
+    return kernel_mode() == "pallas"
 
 
 # ---------------------------------------------------------------------------
@@ -813,8 +840,10 @@ def _sharded_flash_ok(mesh, q, k) -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _flash_attention(q, k, v, mask, causal, block_q, block_k):
-    if _use_pallas():
-        return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k)
+    mode = kernel_mode()
+    if mode in ("pallas", "interpret"):
+        return _flash_fwd_pallas(q, k, v, mask, causal, block_q, block_k,
+                                 interpret=(mode == "interpret"))
     mesh = active_pallas_mesh()
     if mesh is not None and _sharded_flash_ok(mesh, q, k):
         return flash_attention_sharded(mesh, q, k, v, mask, causal, block_q, block_k)
@@ -822,8 +851,10 @@ def _flash_attention(q, k, v, mask, causal, block_q, block_k):
 
 
 def _flash_fwd_rule(q, k, v, mask, causal, block_q, block_k):
-    if _use_pallas():
-        out, lse = _flash_fwd_pallas_lse(q, k, v, mask, causal, block_q, block_k)
+    mode = kernel_mode()
+    if mode in ("pallas", "interpret"):
+        out, lse = _flash_fwd_pallas_lse(q, k, v, mask, causal, block_q, block_k,
+                                         interpret=(mode == "interpret"))
         return out, (q, k, v, mask, out, lse)
     mesh = active_pallas_mesh()
     if mesh is not None and _sharded_flash_ok(mesh, q, k):
@@ -849,9 +880,11 @@ def _flash_bwd_rule(causal, block_q, block_k, res, g):
     # FlashAttention-2 backward from the (out, lse) residuals: primal-only
     # blockwise math, O(t · block) memory (Pallas kernels on a single TPU
     # chip; the same algorithm as plain XLA scans elsewhere)
-    if _use_pallas():
+    mode = kernel_mode()
+    if mode in ("pallas", "interpret"):
         dq, dk, dv = _flash_bwd_pallas(q, k, v, mask, out, lse, g,
-                                       causal, block_q, block_k)
+                                       causal, block_q, block_k,
+                                       interpret=(mode == "interpret"))
     else:
         dq, dk, dv = _flash_bwd_xla(q, k, v, mask, out, lse, g, causal, block_k)
     return dq, dk, dv, None

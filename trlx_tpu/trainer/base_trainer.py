@@ -570,6 +570,7 @@ class TPUTrainer(BaseRLTrainer):
             prefix_cache_capacity=icfg.prefix_cache_capacity,
             multi_tenant=icfg.multi_tenant,
             adapter_store=adapter_store,
+            decode_kernel=icfg.decode_kernel,
             compile_ledger=serve_compile_ledger,
             hbm_ledger=serve_hbm,
         )
